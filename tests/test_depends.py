@@ -1,0 +1,108 @@
+"""DI kernel contracts (reference parity: tests/test_deps.py:25-45)."""
+
+from unittest.mock import Mock
+
+from tpusystem.depends import Depends, Provider, inject
+
+
+def test_plain_dependency_resolves():
+    provider = Provider()
+
+    def dependency():
+        return 42
+
+    @inject(provider)
+    def function(value: int = Depends(dependency)):
+        return value
+
+    assert function() == 42
+
+
+def test_generator_dependency_opens_and_closes():
+    provider = Provider()
+    witness = Mock()
+
+    def dependency():
+        witness.opened()
+        yield 'resource'
+        witness.closed()
+
+    @inject(provider)
+    def function(resource: str = Depends(dependency)):
+        assert not witness.closed.called
+        return resource
+
+    assert function() == 'resource'
+    witness.opened.assert_called_once()
+    witness.closed.assert_called_once()
+
+
+def test_override_replaces_plain_with_generator():
+    provider = Provider()
+    witness = Mock()
+
+    def dependency():
+        raise NotImplementedError
+
+    def replacement():
+        yield 'late-bound'
+        witness.closed()
+
+    provider.override(dependency, replacement)
+
+    @inject(provider)
+    def function(value=Depends(dependency)):
+        return value
+
+    assert function() == 'late-bound'
+    witness.closed.assert_called_once()
+
+
+def test_explicit_argument_wins_over_dependency():
+    provider = Provider()
+
+    @inject(provider)
+    def function(value=Depends(lambda: 'injected')):
+        return value
+
+    assert function('explicit') == 'explicit'
+
+
+def test_nested_dependencies_resolve_recursively():
+    provider = Provider()
+
+    def config():
+        return {'device_count': 8}
+
+    def mesh(cfg=Depends(config)):
+        return f"mesh[{cfg['device_count']}]"
+
+    @inject(provider)
+    def function(m=Depends(mesh)):
+        return m
+
+    assert function() == 'mesh[8]'
+    provider.override(config, lambda: {'device_count': 2})
+    assert function() == 'mesh[2]'
+
+
+def test_shared_dependency_materialized_once_per_call():
+    provider = Provider()
+    calls = []
+
+    def shared():
+        calls.append(1)
+        return object()
+
+    def left(s=Depends(shared)):
+        return s
+
+    def right(s=Depends(shared)):
+        return s
+
+    @inject(provider)
+    def function(a=Depends(left), b=Depends(right)):
+        return a is b
+
+    assert function() is True
+    assert len(calls) == 1

@@ -1,0 +1,88 @@
+"""Domain event contracts (reference parity: tests/test_events.py:12-76)."""
+
+from unittest.mock import Mock
+
+import pytest
+
+from tpusystem.domain.events import Event, Events
+
+
+class Occurred(Event):
+    def __init__(self, payload):
+        self.payload = payload
+
+
+class Marker(Event):
+    ...
+
+
+def test_unhandled_exception_raises_at_commit():
+    events = Events()
+    events.enqueue(StopIteration)
+    with pytest.raises(StopIteration):
+        events.commit()
+
+
+def test_unhandled_exception_instance_raises():
+    events = Events()
+    events.enqueue(ValueError('epoch regression'))
+    with pytest.raises(ValueError, match='epoch regression'):
+        events.commit()
+
+
+def test_handled_exception_is_suppressed():
+    events = Events()
+    witness = Mock()
+    events.handlers[StopIteration] = lambda: witness()
+    events.enqueue(StopIteration)
+    events.commit()
+    witness.assert_called_once()
+
+
+def test_unhandled_plain_event_is_dropped():
+    events = Events()
+    events.enqueue(Marker)
+    events.enqueue(Occurred('x'))
+    events.commit()  # no raise
+    assert not events.queue
+
+
+def test_class_event_dispatch_without_argument():
+    events = Events()
+    witness = Mock()
+    events.handlers[Marker] = lambda: witness('no-arg')
+    events.enqueue(Marker)
+    events.commit()
+    witness.assert_called_once_with('no-arg')
+
+
+def test_instance_event_delivers_payload():
+    events = Events()
+    seen = []
+    events.handlers[Occurred] = lambda event: seen.append(event.payload)
+    events.enqueue(Occurred('value'))
+    events.commit()
+    assert seen == ['value']
+
+
+def test_queue_drains_in_fifo_order():
+    events = Events()
+    order = []
+    events.handlers[Occurred] = lambda e: order.append(e.payload)
+    events.handlers[Marker] = lambda: order.append('marker')
+    events.enqueue(Occurred(1))
+    events.enqueue(Marker)
+    events.enqueue(Occurred(2))
+    events.commit()
+    assert order == [1, 'marker', 2]
+    assert events.dequeue() is None
+
+
+def test_handler_sequence_all_called():
+    events = Events()
+    first, second = Mock(), Mock()
+    events.handlers[Marker] = [lambda: first(), lambda: second()]
+    events.enqueue(Marker)
+    events.commit()
+    first.assert_called_once()
+    second.assert_called_once()

@@ -1,0 +1,13 @@
+from tpusystem.registry.accessors import (
+    Registry,
+    getarguments,
+    gethash,
+    getmetadata,
+    getname,
+    register,
+    sethash,
+    setname,
+)
+
+__all__ = ['Registry', 'register', 'getarguments', 'getname', 'gethash',
+           'sethash', 'setname', 'getmetadata']
