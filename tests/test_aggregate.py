@@ -1,0 +1,58 @@
+"""Aggregate contracts (reference parity: tests/test_aggregate.py:14-17)."""
+
+from unittest.mock import Mock
+
+import pytest
+
+from tpusystem import Aggregate
+
+
+class Model(Aggregate):
+    def __init__(self):
+        super().__init__()
+        self.epoch = 0
+        self.phase_witness = Mock()
+        self.epoch_witness = Mock()
+
+    @property
+    def id(self):
+        return 'model-under-test'
+
+    def onphase(self):
+        self.phase_witness(self.phase)
+
+    def onepoch(self):
+        self.epoch_witness(self.epoch)
+
+
+def test_epoch_assignment_fires_hook_once_and_preserves_value():
+    model = Model()
+    assert model.epoch == 0
+    model.epoch_witness.assert_not_called()  # __init__ assignment is silent
+    model.epoch += 1
+    assert model.epoch == 1
+    model.epoch_witness.assert_called_once_with(1)
+
+
+def test_phase_state_machine():
+    model = Model()
+    assert model.phase == 'train'
+    model.phase = 'evaluation'
+    assert model.phase == 'evaluation'
+    model.phase_witness.assert_called_once_with('evaluation')
+    model.phase = 'train'
+    assert model.phase == 'train'
+
+
+def test_events_queue_available_for_early_stopping():
+    model = Model()
+    model.events.enqueue(StopIteration)
+    with pytest.raises(StopIteration):
+        model.events.commit()
+
+
+def test_id_is_abstract():
+    class NoId(Aggregate):
+        ...
+    with pytest.raises(TypeError):
+        NoId()

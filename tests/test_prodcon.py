@@ -1,0 +1,115 @@
+"""Producer/Consumer contracts (reference parity: tests/test_prodcon.py:24-47)."""
+
+import typing
+
+from tpusystem.services import Consumer, Producer, event
+from tpusystem.depends import Depends
+
+
+@event
+class ModelTrained:
+    model: object
+    metrics: list
+
+
+@event
+class ModelEvaluated:
+    model: object
+    metrics: list
+
+
+@event
+class Iterated:
+    epoch: int
+
+
+def test_union_annotation_registers_both_types():
+    consumer = Consumer()
+    seen = []
+
+    @consumer.handler
+    def on_iterated(event: ModelTrained | ModelEvaluated):
+        seen.append(type(event).__name__)
+
+    consumer.consume(ModelTrained('m', []))
+    consumer.consume(ModelEvaluated('m', []))
+    assert seen == ['ModelTrained', 'ModelEvaluated']
+    assert set(consumer.handlers) == {'model-trained', 'model-evaluated'}
+
+
+def test_typing_union_form_also_registers():
+    consumer = Consumer()
+    seen = []
+
+    @consumer.handler
+    def on_any(event: typing.Union[ModelTrained, Iterated]):
+        seen.append(type(event).__name__)
+
+    consumer.consume(Iterated(3))
+    assert seen == ['Iterated']
+
+
+def test_unknown_event_type_silently_ignored():
+    consumer = Consumer()
+
+    @consumer.handler
+    def on_trained(event: ModelTrained):
+        raise AssertionError('should not run')
+
+    consumer.consume(Iterated(1))  # no handler -> ignored
+
+
+def test_dependency_injection_into_handlers():
+    consumer = Consumer()
+    database = []
+
+    def db():
+        raise NotImplementedError
+
+    @consumer.handler
+    def persist(event: Iterated, db: list = Depends(db)):
+        db.append(event.epoch)
+
+    consumer.dependency_overrides[db] = lambda: database
+    consumer.consume(Iterated(7))
+    assert database == [7]
+
+
+def test_producer_fans_out_to_all_consumers():
+    first, second = Consumer(), Consumer()
+    calls = []
+
+    @first.handler
+    def a(event: Iterated):
+        calls.append(('first', event.epoch))
+
+    @second.handler
+    def b(event: Iterated):
+        calls.append(('second', event.epoch))
+
+    producer = Producer()
+    producer.register(first, second)
+    producer.dispatch(Iterated(1))
+    assert calls == [('first', 1), ('second', 1)]
+
+
+def test_multiple_handlers_per_event_type():
+    consumer = Consumer()
+    calls = []
+
+    @consumer.handler
+    def one(event: Iterated):
+        calls.append(1)
+
+    @consumer.handler
+    def two(event: Iterated):
+        calls.append(2)
+
+    consumer.consume(Iterated(0))
+    assert calls == [1, 2]
+
+
+def test_kebab_name_generation():
+    consumer = Consumer()
+    assert consumer.generator('ModelTrained') == 'model-trained'
+    assert consumer.generator('Trained') == 'trained'

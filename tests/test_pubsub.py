@@ -1,0 +1,58 @@
+"""Publisher/Subscriber contracts (reference parity: tests/test_pubsub.py:12-36)."""
+
+import pytest
+
+from tpusystem.services import Publisher, Subscriber
+from tpusystem.depends import Depends
+
+
+def test_multi_topic_subscription_with_di():
+    subscriber = Subscriber()
+    stored = []
+
+    def metrics():
+        raise NotImplementedError
+
+    @subscriber.subscribe('loss', 'accuracy')
+    def store(metric, metrics: list = Depends(metrics)):
+        metrics.append(metric)
+
+    subscriber.dependency_overrides[metrics] = lambda: stored
+
+    publisher = Publisher()
+    publisher.register(subscriber)
+    publisher.publish(0.1, 'loss')
+    publisher.publish(0.9, 'accuracy')
+    publisher.publish('ignored', 'other-topic')
+    assert stored == [0.1, 0.9]
+
+
+def test_handler_exception_propagates_to_publisher():
+    subscriber = Subscriber()
+
+    @subscriber.subscribe('accuracy')
+    def early_stop(metric):
+        if metric > 0.99:
+            raise StopIteration
+
+    publisher = Publisher()
+    publisher.register(subscriber)
+    publisher.publish(0.5, 'accuracy')  # fine
+    with pytest.raises(StopIteration):
+        publisher.publish(1.0, 'accuracy')
+
+
+def test_reentrant_receive_reroutes_between_handlers():
+    subscriber = Subscriber()
+    seen = []
+
+    @subscriber.subscribe('raw')
+    def reroute(message):
+        subscriber.receive(message * 2, 'derived')
+
+    @subscriber.subscribe('derived')
+    def collect(message):
+        seen.append(message)
+
+    subscriber.receive(21, 'raw')
+    assert seen == [42]

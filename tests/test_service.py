@@ -1,0 +1,52 @@
+"""Service contracts (reference parity: tests/test_service.py:12-21)."""
+
+from unittest.mock import Mock
+
+import pytest
+
+from tpusystem.services import Service
+from tpusystem.depends import Depends
+
+
+def test_handler_registered_under_kebab_name_with_override():
+    service = Service()
+
+    def device():
+        raise NotImplementedError
+
+    @service.handler
+    def train_model(model, device=Depends(device)):
+        model.trained_on(device)
+        return device
+
+    service.dependency_overrides[device] = lambda: 'tpu:0'
+    model = Mock()
+    assert service.handle('train-model', model) == 'tpu:0'
+    model.trained_on.assert_called_once_with('tpu:0')
+
+
+def test_handler_remains_directly_callable():
+    service = Service()
+
+    @service.handler
+    def validate(model):
+        return ('validated', model)
+
+    assert validate('m') == ('validated', 'm')
+    assert service.handle('validate', 'm') == ('validated', 'm')
+
+
+def test_unknown_action_raises_keyerror():
+    service = Service()
+    with pytest.raises(KeyError):
+        service.handle('missing-action')
+
+
+def test_custom_name_generator():
+    service = Service(generator=str.upper)
+
+    @service.handler
+    def iterate():
+        return 'ok'
+
+    assert service.handle('ITERATE') == 'ok'

@@ -1,0 +1,16 @@
+"""tpusystem — TPU-native, message-driven training framework.
+
+The architecture of mapache-software/torch-system (aggregates, domain
+events, dependency injection, service buses, entity registry) rebuilt
+TPU-first: pure jitted step functions over parameter pytrees, GSPMD
+sharding on explicit device meshes, Pallas kernels for the hot ops, and a
+control-plane bus that spans multi-host TPU pods.
+"""
+
+from tpusystem.compiler import Compiler
+from tpusystem.depends import Depends, Provider
+from tpusystem.domain import Aggregate, Event, Events
+
+__version__ = '0.1.0'
+
+__all__ = ['Aggregate', 'Compiler', 'Depends', 'Provider', 'Event', 'Events']

@@ -1,0 +1,76 @@
+"""Aggregate compilation pipeline.
+
+Building an aggregate for TPU execution is a staged process: construct the
+module tree, place parameters on the device mesh with their shardings, lower
+the train/eval steps through ``jax.jit``/GSPMD, then restore state from a
+checkpoint keyed by the aggregate's identity. Each stage may need runtime
+facts (the mesh, the checkpoint store, the resume epoch) that only exist at
+composition time — so steps are DI-injected callables, mirroring the
+reference ``Compiler`` (``torchsystem/compiler.py:105-168``).
+
+Chaining contract: the first step receives ``compile(*args)``'s arguments; a
+step returning a tuple is splatted into the next step; any other value is
+passed as the single argument. A step returning ``None`` is treated as a
+side-effect stage: the next step receives the latest produced value — or the
+original ``compile(*args, **kwargs)`` arguments when no step has produced a
+value yet. This is a deliberate cleanup of the reference's falsy-result quirk
+(``torchsystem/compiler.py:164`` re-sends the original args whenever a step
+returns *any* falsy value; here only ``None`` passes through).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any, Generic, TypeVar
+
+import jax
+
+from tpusystem.depends import Depends as Depends  # re-export for pipelines
+from tpusystem.depends import Provider, inject
+
+T = TypeVar('T')
+
+_PENDING = object()  # no step has produced a value yet
+
+# The TPU analogue of the reference re-exporting ``torch.compile``
+# (``torchsystem/compiler.py:22``): pipelines call ``compile(step_fn, ...)``
+# to lower pure step functions for the mesh.
+compile = jax.jit
+
+
+class Compiler(Generic[T]):
+    """DI-aware pipeline of build steps producing a compiled aggregate."""
+
+    def __init__(self, *, provider: Provider | None = None) -> None:
+        self.steps: list[Callable] = []
+        self.provider = provider or Provider()
+
+    @property
+    def dependency_overrides(self) -> dict:
+        """Override table for late-binding runtime facts into steps.
+
+        Example::
+
+            compiler.dependency_overrides[mesh] = lambda: Mesh(jax.devices(), ('data',))
+        """
+        return self.provider.dependency_overrides
+
+    def step(self, callable: Callable) -> Callable:
+        """Register a pipeline stage (decorator). Returns the injected fn."""
+        injected = inject(self.provider)(callable)
+        self.steps.append(injected)
+        return injected
+
+    def compile(self, *args, **kwargs) -> T | Any | None:
+        """Run the pipeline; the last stage's product is the aggregate."""
+        current: Any = _PENDING
+        for step in self.steps:
+            if current is _PENDING:
+                produced = step(*args, **kwargs)
+            elif isinstance(current, tuple):
+                produced = step(*current)
+            else:
+                produced = step(current)
+            if produced is not None:
+                current = produced
+        return None if current is _PENDING else current

@@ -1,0 +1,4 @@
+from tpusystem.domain.aggregate import Aggregate, Phase
+from tpusystem.domain.events import Event, Events
+
+__all__ = ['Aggregate', 'Phase', 'Event', 'Events']

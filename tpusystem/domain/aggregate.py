@@ -1,0 +1,93 @@
+"""Aggregate root for TPU training systems.
+
+A DDD *aggregate* is the unit of consistency a training service operates on:
+the neural network plus everything needed to train/evaluate it (optimizer
+state, RNG streams, tokenizer, ...). The reference builds this on a mutable
+``torch.nn.Module`` (``torchsystem/domain/aggregate.py:26``); the TPU-native
+design splits the aggregate in two:
+
+* **host side** (this class): identity, phase state machine, epoch hooks and
+  the domain-event queue — plain Python, mutated freely between steps;
+* **device side**: an immutable parameter/optimizer pytree (see
+  :class:`tpusystem.train.state.TrainState`) advanced only by pure, jitted
+  step functions. Subclasses hold the pytree as an attribute and replace it
+  wholesale each step (``self.state = self._step(self.state, batch)``).
+
+This keeps the reference's ergonomic API (``model.phase = 'train'``,
+``model.epoch += 1`` firing hooks, ``model.events.enqueue(StopIteration)``)
+while the math stays XLA-compilable: nothing on the host side is ever traced.
+
+Behavioral parity contracts (``torchsystem/domain/aggregate.py:102-158``):
+``id`` is abstract; ``phase`` maps the training flag to
+``'train' | 'evaluation'``; setting ``phase`` flips the flag then calls
+``onphase()``; assigning ``epoch`` calls ``onepoch()`` only when the
+attribute already existed (so ``__init__`` assignment does not fire it).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Literal
+
+from tpusystem.domain.events import Events
+
+Phase = Literal['train', 'evaluation'] | str
+
+
+class Aggregate(ABC):
+    """Host-side aggregate root with phase/epoch hooks and domain events."""
+
+    def __init__(self) -> None:
+        self.events = Events()
+        self._training = True
+
+    @property
+    @abstractmethod
+    def id(self) -> Any:
+        """Unique identity of the aggregate root within its boundary.
+
+        Use :func:`tpusystem.registry.gethash` over the registered network
+        definition for a deterministic, restart-stable id that keys
+        experiment rows and checkpoint directories.
+        """
+
+    @property
+    def phase(self) -> Phase:
+        """``'train'`` while in training mode, ``'evaluation'`` otherwise.
+
+        On TPU the phase decides which jitted step executes (the train step
+        with dropout RNGs and optimizer update, or the eval step with
+        deterministic forward) — the analogue of torch's
+        ``train()/eval()`` mode flag.
+        """
+        return 'train' if self._training else 'evaluation'
+
+    @phase.setter
+    def phase(self, value: Phase) -> None:
+        self.train() if value == 'train' else self.eval()
+        self.onphase()
+
+    def train(self) -> None:
+        """Enter training mode. Subclasses may extend (e.g. swap step fns)."""
+        self._training = True
+
+    def eval(self) -> None:
+        """Enter evaluation mode."""
+        self._training = False
+
+    def onphase(self) -> None:
+        """Hook fired after every phase change. Override for custom behavior."""
+
+    def onepoch(self) -> None:
+        """Hook fired after every epoch assignment (post-``__init__``).
+
+        Typical use: ``self.events.commit()`` so exceptions enqueued during
+        the epoch (early stopping) unwind into the epoch loop here.
+        """
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name == 'epoch' and hasattr(self, 'epoch'):
+            super().__setattr__(name, value)
+            self.onepoch()
+        else:
+            super().__setattr__(name, value)
