@@ -8,10 +8,16 @@ single-process CPU-only; we add simulated-multi-device coverage).
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax
+
+# The environment tunnels a real TPU chip and its plugin *prepends* itself to
+# jax_platforms (config becomes 'axon,cpu'), so neither JAX_PLATFORMS=cpu in
+# the env nor setdefault wins. Forcing the config after import does.
+jax.config.update('jax_platforms', 'cpu')
 
 import pathlib
 import shutil

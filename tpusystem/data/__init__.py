@@ -1,0 +1,4 @@
+from tpusystem.data.loader import ArrayDataset, Loader
+from tpusystem.data.datasets import SyntheticDigits, SyntheticTokens, TorchDataset
+
+__all__ = ['ArrayDataset', 'Loader', 'SyntheticDigits', 'SyntheticTokens', 'TorchDataset']

@@ -1,0 +1,63 @@
+"""Built-in datasets.
+
+The environment has no network egress, so the MNIST-shaped workloads the
+reference trains on (``examples/tinysys/tinysys/datasets/mnist.py``) are
+modeled by deterministic synthetic datasets with the same shapes and a
+learnable signal — sufficient for end-to-end and convergence tests. A torch
+``Dataset`` adapter covers users bringing their own torch data pipelines.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tpusystem.data.loader import ArrayDataset
+from tpusystem.registry import register
+
+
+@register
+class SyntheticDigits(ArrayDataset):
+    """MNIST-shaped 28x28 classification set: each class is a Gaussian blob
+    around a fixed random prototype, so a small MLP separates it quickly."""
+
+    def __init__(self, samples: int = 4096, classes: int = 10, seed: int = 0,
+                 noise: float = 0.35, train: bool = True):
+        rng = np.random.default_rng(seed if train else seed + 1)
+        prototype_rng = np.random.default_rng(seed)  # shared across splits
+        prototypes = prototype_rng.normal(size=(classes, 28 * 28)).astype(np.float32)
+        labels = rng.integers(0, classes, size=samples)
+        images = prototypes[labels] + noise * rng.normal(size=(samples, 28 * 28)).astype(np.float32)
+        super().__init__(images.reshape(samples, 28, 28).astype(np.float32),
+                         labels.astype(np.int32))
+
+
+@register
+class SyntheticTokens(ArrayDataset):
+    """Language-model token streams with learnable bigram structure."""
+
+    def __init__(self, samples: int = 1024, sequence_length: int = 128,
+                 vocab_size: int = 256, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        # fixed sparse bigram transition table -> sequences are predictable
+        table = rng.integers(0, vocab_size, size=(vocab_size, 4))
+        tokens = np.empty((samples, sequence_length), dtype=np.int32)
+        tokens[:, 0] = rng.integers(0, vocab_size, size=samples)
+        choices = rng.integers(0, 4, size=(samples, sequence_length))
+        for position in range(1, sequence_length):
+            tokens[:, position] = table[tokens[:, position - 1], choices[:, position]]
+        super().__init__(tokens)
+
+
+class TorchDataset(ArrayDataset):
+    """Adapter: materialize a (map-style) torch dataset into arrays once,
+    so batches feed the TPU without per-batch torch->numpy conversion."""
+
+    def __init__(self, dataset):
+        first = dataset[0]
+        columns = len(first) if isinstance(first, (tuple, list)) else 1
+        stacked = [[] for _ in range(columns)]
+        for item in dataset:
+            parts = item if isinstance(item, (tuple, list)) else (item,)
+            for column, part in enumerate(parts):
+                stacked[column].append(np.asarray(part))
+        super().__init__(*[np.stack(column) for column in stacked])

@@ -1,0 +1,3 @@
+from tpusystem.models.mlp import MLP
+
+__all__ = ['MLP']

@@ -1,0 +1,37 @@
+"""Multi-layer perceptron (the reference model zoo's first entry:
+``examples/tinysys/tinysys/modules/mlp.py`` — 2-layer MLP with dropout)."""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from tpusystem.registry import register
+
+
+@register
+class MLP(nn.Module):
+    """Flattening MLP classifier with dropout between hidden layers.
+
+    Attributes:
+        features: hidden-layer widths.
+        classes: output dimension.
+        dropout: drop probability applied after each hidden activation.
+        dtype: activation dtype (bfloat16 on TPU keeps the MXU fed).
+    """
+
+    features: Sequence[int] = (256, 128)
+    classes: int = 10
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, inputs, train: bool = False):
+        hidden = inputs.reshape((inputs.shape[0], -1)).astype(self.dtype)
+        for width in self.features:
+            hidden = nn.Dense(width, dtype=self.dtype)(hidden)
+            hidden = nn.relu(hidden)
+            hidden = nn.Dropout(self.dropout, deterministic=not train)(hidden)
+        return nn.Dense(self.classes, dtype=jnp.float32)(hidden)

@@ -43,11 +43,38 @@ def getname(obj: object) -> str:
     return core.get_name(obj) or obj.__class__.__name__
 
 
+def _hash_fallback(value: Any) -> Any:
+    """Deterministic JSON encoding for non-JSON captured arguments.
+
+    Types (including dtype sentinels like ``jnp.bfloat16``) encode as their
+    name; sets encode as sorted lists (set repr order is hash-randomized
+    across processes). A value whose repr embeds a memory address has no
+    stable cross-process identity — raising here (like the reference's bare
+    ``json.dumps`` would) beats silently aliasing two different experiments
+    to one hash; the fix is to ``register`` the value's class.
+    """
+    if isinstance(value, type):
+        return getattr(value, '__name__', str(value))
+    if isinstance(value, (set, frozenset)):
+        return sorted(dumps(item, default=_hash_fallback) for item in value)
+    rendered = repr(value)
+    if ' at 0x' in rendered:
+        raise TypeError(
+            f'cannot derive a stable identity for captured argument of type '
+            f'{value.__class__.__qualname__}: its repr embeds a memory address. '
+            f'register() its class so it captures constructor arguments, or '
+            f'exclude it via excluded_args/excluded_kwargs.')
+    return rendered
+
+
 def gethash(obj: object) -> str:
     """Deterministic identity hash of a registered object.
 
     A manually assigned hash (:func:`sethash`) takes precedence; otherwise
-    ``md5(getname(obj) + json.dumps(getarguments(obj)))``.
+    ``md5(getname(obj) + json.dumps(getarguments(obj)))``. Non-JSON argument
+    values (dtypes, nested unregistered objects) are canonicalized via
+    :func:`_hash_fallback`; pure-JSON captures hash byte-identically to the
+    reference (pinned digest ``b12461be...``).
 
     Raises:
         AttributeError: when the object has neither captured arguments nor a
@@ -59,7 +86,8 @@ def gethash(obj: object) -> str:
     if core.get_arguments(obj) is None:
         raise AttributeError(
             f'{obj.__class__.__name__} has no identity: register the class or sethash()')
-    return md5((getname(obj) + dumps(getarguments(obj))).encode()).hexdigest()
+    payload = dumps(getarguments(obj), default=_hash_fallback)
+    return md5((getname(obj) + payload).encode()).hexdigest()
 
 
 def sethash(obj: object, hash: str | None = None) -> None:

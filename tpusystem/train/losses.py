@@ -1,0 +1,64 @@
+"""Loss criteria as registered entities.
+
+The reference registers ``torch.nn.CrossEntropyLoss`` so the criterion
+participates in experiment identity (``examples/tinysys/main.py:27-32``).
+These are their pure-functional equivalents: hashable hyperparameter
+recipes whose ``__call__`` is jit-traceable.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpusystem.registry import register
+
+
+@register
+class CrossEntropyLoss:
+    """Softmax cross-entropy over integer labels, with optional smoothing."""
+
+    def __init__(self, label_smoothing: float = 0.0):
+        self.label_smoothing = label_smoothing
+
+    def __call__(self, logits, targets):
+        if self.label_smoothing:
+            classes = logits.shape[-1]
+            onehot = optax.smooth_labels(
+                jnp.eye(classes, dtype=logits.dtype)[targets], self.label_smoothing)
+            losses = optax.softmax_cross_entropy(logits, onehot)
+        else:
+            losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        return jnp.mean(losses)
+
+
+@register
+class MSELoss:
+    def __init__(self):
+        ...
+
+    def __call__(self, predictions, targets):
+        return jnp.mean((predictions - targets) ** 2)
+
+
+@register
+class NextTokenLoss:
+    """Causal LM loss: cross-entropy of logits[:, :-1] vs tokens[:, 1:],
+    with padding mask support (pad id < 0 excluded)."""
+
+    def __init__(self, z_loss: float = 0.0):
+        self.z_loss = z_loss
+
+    def __call__(self, logits, tokens):
+        shifted_logits = logits[:, :-1]
+        shifted_targets = tokens[:, 1:]
+        mask = (shifted_targets >= 0).astype(jnp.float32)
+        safe_targets = jnp.maximum(shifted_targets, 0)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            shifted_logits.astype(jnp.float32), safe_targets)
+        loss = jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        if self.z_loss:
+            logsumexp = jax.nn.logsumexp(shifted_logits.astype(jnp.float32), axis=-1)
+            loss = loss + self.z_loss * jnp.sum((logsumexp ** 2) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return loss

@@ -1,0 +1,115 @@
+"""On-device metric accumulators.
+
+The reference accumulates metrics per batch with torcheval on the CUDA
+device and materializes them once per phase
+(``examples/tinysys/tinysys/metrics.py:8-27``) — the cadence that keeps the
+event bus off the hot path. These accumulators do the same on TPU: ``update``
+runs a tiny jitted program against device values (no host sync, no
+data-dependent Python), ``compute`` performs the single ``jax.device_get``
+per phase.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Protocol
+
+import jax
+import jax.numpy as jnp
+
+
+class Metric(Protocol):
+    def update(self, *args, **kwargs) -> None: ...
+    def compute(self) -> float: ...
+    def reset(self) -> None: ...
+
+
+@jax.jit
+def _mean_update(total, count, values, weight):
+    return total + jnp.sum(values) * weight, count + values.size * weight
+
+
+@jax.jit
+def _accuracy_update(correct, count, predictions, targets):
+    return correct + jnp.sum(predictions == targets), count + targets.size
+
+
+class Mean:
+    """Weighted running mean of scalar or array values (loss, grad-norm...)."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._total = jnp.zeros((), jnp.float32)
+        self._count = jnp.zeros((), jnp.float32)
+
+    def update(self, values, weight: float = 1.0) -> None:
+        self._total, self._count = _mean_update(
+            self._total, self._count, jnp.asarray(values, jnp.float32), weight)
+
+    def compute(self) -> float:
+        total, count = jax.device_get((self._total, self._count))
+        return float(total / count) if count else 0.0
+
+
+class Accuracy:
+    """Multiclass accuracy from integer predictions vs targets."""
+
+    def __init__(self) -> None:
+        self.reset()
+
+    def reset(self) -> None:
+        self._correct = jnp.zeros((), jnp.int32)
+        self._count = jnp.zeros((), jnp.int32)
+
+    def update(self, predictions, targets) -> None:
+        self._correct, self._count = _accuracy_update(
+            self._correct, self._count, predictions, targets)
+
+    def compute(self) -> float:
+        correct, count = jax.device_get((self._correct, self._count))
+        return float(correct / count) if count else 0.0
+
+
+@partial(jax.jit, static_argnames='k')
+def _topk_update(hits, count, logits, targets, k):
+    top = jax.lax.top_k(logits, k)[1]
+    match = jnp.any(top == targets[..., None], axis=-1)
+    return hits + jnp.sum(match), count + targets.size
+
+
+class TopKAccuracy:
+    """Top-k accuracy from logits vs integer targets."""
+
+    def __init__(self, k: int = 5) -> None:
+        self.k = k
+        self.reset()
+
+    def reset(self) -> None:
+        self._hits = jnp.zeros((), jnp.int32)
+        self._count = jnp.zeros((), jnp.int32)
+
+    def update(self, logits, targets) -> None:
+        self._hits, self._count = _topk_update(self._hits, self._count, logits, targets, self.k)
+
+    def compute(self) -> float:
+        hits, count = jax.device_get((self._hits, self._count))
+        return float(hits / count) if count else 0.0
+
+
+class Perplexity:
+    """exp(mean token cross-entropy) for language models."""
+
+    def __init__(self) -> None:
+        self._mean = Mean()
+
+    def reset(self) -> None:
+        self._mean.reset()
+
+    def update(self, token_losses, weight: float = 1.0) -> None:
+        self._mean.update(token_losses, weight)
+
+    def compute(self) -> float:
+        import math
+        return math.exp(min(self._mean.compute(), 80.0))

@@ -1,0 +1,50 @@
+"""Device-side aggregate state.
+
+The reference's aggregate is a mutable ``nn.Module`` whose weights, optimizer
+slots and step counter change in place (``torchsystem/domain/aggregate.py``).
+On TPU that state must be an immutable pytree advanced by pure, jitted
+functions — :class:`TrainState` is that pytree. Host-side concerns (phase,
+epoch, events) stay on :class:`tpusystem.domain.Aggregate`; everything the
+compiled step needs threads through here.
+
+``TrainState`` is a registered JAX pytree dataclass: it can be donated into
+a jitted step (buffer reuse in HBM), sharded over a mesh with
+``NamedSharding``, and checkpointed as a single tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    """Immutable training-state pytree.
+
+    Attributes:
+        params: model parameter pytree (typically bfloat16/float32 leaves).
+        opt_state: optimizer slot variables (moments etc.).
+        rng: PRNG key folded each step for dropout and other stochastic ops.
+        step: scalar int32 step counter, lives on device so incrementing it
+            never forces a host sync.
+    """
+
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    step: jax.Array
+
+    @classmethod
+    def create(cls, params: Any, opt_state: Any, rng: jax.Array | int = 0) -> 'TrainState':
+        if isinstance(rng, int):
+            rng = jax.random.PRNGKey(rng)
+        return cls(params=params, opt_state=opt_state, rng=rng,
+                   step=jnp.zeros((), dtype=jnp.int32))
+
+    def next_rng(self) -> tuple['TrainState', jax.Array]:
+        """Split the carried key; returns (state-with-new-key, subkey)."""
+        rng, sub = jax.random.split(self.rng)
+        return self.replace(rng=rng), sub

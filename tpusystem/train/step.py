@@ -1,0 +1,109 @@
+"""Jitted step builders — the TPU hot path.
+
+The reference's per-step work is eager autograd driven from a Python batch
+loop (``examples/tinysys/tinysys/classifier.py:29-35``:
+zero_grad -> forward -> loss -> backward -> step). Here the whole step is a
+single pure function lowered once through ``jax.jit``:
+
+* forward + loss via ``jax.value_and_grad`` (autograd seam),
+* optimizer update fused into the same XLA program,
+* the :class:`~tpusystem.train.state.TrainState` argument is **donated**, so
+  parameters and optimizer slots update in place in HBM (no copy),
+* gradient all-reduce over the mesh data axis is inserted by GSPMD when the
+  batch is sharded — the step body is identical on 1 chip and on a pod.
+
+Metrics consumed by the event bus must read only the returned loss/outputs
+*after* the phase completes (one device->host sync per phase, never per
+batch) — the cadence the reference models with ``metrics.compute()``
+(``examples/tinysys/tinysys/metrics.py:19-23``).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from inspect import signature
+from typing import Any
+
+import jax
+import optax
+
+from tpusystem.train.state import TrainState
+
+# apply_fn contract: (params, inputs, rng, train) -> outputs
+ApplyFn = Callable[[Any, Any, jax.Array | None, bool], Any]
+# criterion contract: (outputs, targets) -> scalar loss
+Criterion = Callable[[Any, Any], jax.Array]
+
+
+def flax_apply(module) -> ApplyFn:
+    """Adapt a flax linen module to the step-builder apply contract.
+
+    Passes ``train=`` and dropout RNGs only when the module's ``__call__``
+    accepts them, so simple modules stay simple.
+    """
+    parameters = signature(module.__call__).parameters
+    accepts_train = 'train' in parameters
+
+    def apply(params, inputs, rng=None, train=False):
+        kwargs = {'train': train} if accepts_train else {}
+        rngs = {'dropout': rng} if rng is not None else None
+        return module.apply({'params': params}, inputs, rngs=rngs, **kwargs)
+
+    return apply
+
+
+def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
+                     *, jit: bool = True):
+    """Build ``step(state, inputs, targets) -> (state, (outputs, loss))``.
+
+    ``optimizer`` is a :class:`tpusystem.train.optim.Optimizer` or a raw
+    ``optax.GradientTransformation``. The returned step donates ``state``:
+    callers must treat the passed-in state as consumed.
+    """
+    transform = optimizer.transform() if hasattr(optimizer, 'transform') else optimizer
+
+    def step(state: TrainState, inputs, targets):
+        state, dropout_rng = state.next_rng()
+
+        def objective(params):
+            outputs = apply_fn(params, inputs, dropout_rng, True)
+            return criterion(outputs, targets), outputs
+
+        (loss, outputs), grads = jax.value_and_grad(objective, has_aux=True)(state.params)
+        updates, opt_state = transform.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+        return state, (outputs, loss)
+
+    return jax.jit(step, donate_argnums=0) if jit else step
+
+
+def build_eval_step(apply_fn: ApplyFn, criterion: Criterion, *, jit: bool = True):
+    """Build ``step(state, inputs, targets) -> (outputs, loss)`` (no grads,
+    deterministic forward) — the ``inference_mode`` analogue."""
+
+    def step(state: TrainState, inputs, targets):
+        outputs = apply_fn(state.params, inputs, None, False)
+        return outputs, criterion(outputs, targets)
+
+    return jax.jit(step) if jit else step
+
+
+def init_state(module, optimizer, sample_inputs, *, rng: int | jax.Array = 0,
+               param_dtype=None) -> TrainState:
+    """Initialize a :class:`TrainState` for a flax module.
+
+    Runs ``module.init`` on the sample batch shape, initializes optimizer
+    slots, and seeds the carried RNG stream.
+    """
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    init_rng, carry_rng = jax.random.split(rng)
+    parameters = signature(module.__call__).parameters
+    kwargs = {'train': False} if 'train' in parameters else {}
+    variables = module.init(init_rng, sample_inputs, **kwargs)
+    params = variables['params']
+    if param_dtype is not None:
+        params = jax.tree.map(lambda leaf: leaf.astype(param_dtype), params)
+    transform = optimizer.transform() if hasattr(optimizer, 'transform') else optimizer
+    return TrainState.create(params, transform.init(params), carry_rng)
