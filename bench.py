@@ -1,0 +1,94 @@
+"""Headline benchmark: GPT-2 125M training MFU on one chip.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
+measured MFU against the north-star target of 0.50 MFU (BASELINE.json).
+Model FLOPs use the standard 6*N*T approximation (fwd+bwd) plus exact
+attention term 12*L*H*S^2*D_head*B.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOP/s per chip by device kind substring
+PEAKS = {
+    'v5 lite': 197e12,  # v5e
+    'v5e': 197e12,
+    'v5p': 459e12,
+    'v4': 275e12,
+    'v6': 918e12,
+}
+
+
+def peak_flops(device) -> float | None:
+    kind = device.device_kind.lower()
+    for key, value in PEAKS.items():
+        if key in kind:
+            return value
+    return None
+
+
+def main() -> None:
+    from tpusystem.models import GPT2
+    from tpusystem.train import AdamW, NextTokenLoss, build_train_step, flax_apply, init_state
+
+    batch, seq = 8, 1024
+    module = GPT2(dropout=0.0)
+    optimizer = AdamW(lr=3e-4, grad_clip=1.0)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, module.vocab_size, (batch, seq)),
+        jnp.int32)
+    state = init_state(module, optimizer, tokens[:1, :8])
+    params_count = sum(leaf.size for leaf in jax.tree.leaves(state.params))
+    step = build_train_step(flax_apply(module), NextTokenLoss(), optimizer)
+
+    # warmup / compile. NOTE: force completion by materializing the loss —
+    # jax.block_until_ready returns early through the tunneled-TPU relay.
+    for _ in range(3):
+        state, (_, loss) = step(state, tokens, tokens)
+    float(loss)
+
+    steps = 10
+    start = time.perf_counter()
+    for _ in range(steps):
+        state, (_, loss) = step(state, tokens, tokens)
+    float(loss)
+    elapsed = time.perf_counter() - start
+
+    tokens_per_step = batch * seq
+    head_dim = module.dim // module.heads
+    # 12*L*H*S^2*Dh*B covers fwd (4*S^2*Dh per head: QK^T + AV at 2 FLOPs/MAC)
+    # plus bwd at 2x fwd
+    attention_flops = 12 * module.layers * module.heads * seq * seq * head_dim * batch
+    step_flops = 6 * params_count * tokens_per_step + attention_flops
+    achieved = step_flops * steps / elapsed
+
+    device = jax.devices()[0]
+    peak = peak_flops(device)
+    if peak:
+        mfu = achieved / peak
+        print(json.dumps({
+            'metric': 'gpt2_125m_train_mfu_1chip',
+            'value': round(mfu, 4),
+            'unit': 'MFU',
+            'vs_baseline': round(mfu / 0.5, 4),
+        }))
+    else:  # CPU fallback: report throughput
+        print(json.dumps({
+            'metric': 'gpt2_125m_train_steps_per_sec_cpu',
+            'value': round(steps / elapsed, 4),
+            'unit': 'steps/s',
+            'vs_baseline': 1.0,
+        }))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,129 @@
+"""GPT-2 language model (flagship of the BASELINE.md workload ladder:
+"GPT-2 125M LM aggregate, GSPMD FSDP" — BASELINE.json configs[3]).
+
+TPU-first choices: bfloat16 activations with float32 layernorm/softmax/loss,
+weights kept float32 (master copies) and cast per-use; attention through
+:func:`tpusystem.ops.attention.dot_product_attention`; Megatron-style tensor
+partition rules shipped with the model (``GPT2.partition_rules()``) so the
+``TensorParallel``/``FullyShardedDataParallel`` policies shard it without
+per-experiment configuration.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from tpusystem.ops.attention import dot_product_attention
+from tpusystem.registry import register
+
+
+class SelfAttention(nn.Module):
+    heads: int
+    dropout: float
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, hidden, train: bool = False):
+        dim = hidden.shape[-1]
+        head_dim = dim // self.heads
+        qkv = nn.Dense(3 * dim, dtype=self.dtype, name='qkv')(hidden)
+        query, key, value = jnp.split(qkv, 3, axis=-1)
+        shape = hidden.shape[:2] + (self.heads, head_dim)
+        context = dot_product_attention(
+            query.reshape(shape), key.reshape(shape), value.reshape(shape),
+            causal=True,
+            dropout=self.dropout if train else 0.0,
+            dropout_rng=self.make_rng('dropout') if train and self.dropout else None)
+        context = context.reshape(hidden.shape)
+        return nn.Dense(dim, dtype=self.dtype, name='out')(context)
+
+
+class Block(nn.Module):
+    heads: int
+    mlp_ratio: int
+    dropout: float
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, hidden, train: bool = False):
+        dim = hidden.shape[-1]
+        normed = nn.LayerNorm(dtype=jnp.float32, name='ln_1')(hidden)
+        attended = SelfAttention(self.heads, self.dropout, self.dtype, name='attn')(
+            normed.astype(self.dtype), train)
+        attended = nn.Dropout(self.dropout, deterministic=not train)(attended)
+        hidden = hidden + attended
+        normed = nn.LayerNorm(dtype=jnp.float32, name='ln_2')(hidden)
+        grown = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name='fc')(
+            normed.astype(self.dtype))
+        grown = nn.gelu(grown)
+        shrunk = nn.Dense(dim, dtype=self.dtype, name='proj')(grown)
+        shrunk = nn.Dropout(self.dropout, deterministic=not train)(shrunk)
+        return hidden + shrunk
+
+
+@register
+class GPT2(nn.Module):
+    """Decoder-only transformer with learned positions and tied LM head.
+
+    125M preset == defaults (vocab 50257, 12 x 768, 12 heads, seq 1024).
+    """
+
+    vocab_size: int = 50257
+    layers: int = 12
+    dim: int = 768
+    heads: int = 12
+    max_seq: int = 1024
+    mlp_ratio: int = 4
+    dropout: float = 0.1
+    dtype: str = 'bfloat16'
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        compute_dtype = jnp.dtype(self.dtype)
+        positions = jnp.arange(tokens.shape[-1])
+        token_embedding = nn.Embed(self.vocab_size, self.dim,
+                                   dtype=jnp.float32, name='wte')
+        hidden = token_embedding(tokens)
+        hidden = hidden + nn.Embed(self.max_seq, self.dim,
+                                   dtype=jnp.float32, name='wpe')(positions)
+        hidden = nn.Dropout(self.dropout, deterministic=not train)(hidden)
+        hidden = hidden.astype(compute_dtype)
+        assert tokens.shape[-1] <= self.max_seq, (
+            f'sequence length {tokens.shape[-1]} exceeds max_seq={self.max_seq}')
+        for index in range(self.layers):
+            hidden = Block(self.heads, self.mlp_ratio, self.dropout,
+                           compute_dtype, name=f'h_{index}')(hidden, train)
+        hidden = nn.LayerNorm(dtype=jnp.float32, name='ln_f')(hidden)
+        # tied LM head: logits against the token embedding table, f32 for
+        # a numerically stable softmax/loss
+        return token_embedding.attend(hidden.astype(jnp.float32))
+
+    @staticmethod
+    def partition_rules():
+        """Megatron-style TP rules (combined with FSDP via policy flag).
+
+        qkv/fc split columns on ``model``; out/proj split rows (their
+        all-reduce rides ICI); embeddings split the vocab/position table.
+        """
+        return (
+            (r'attn/qkv/kernel$', P(None, 'model')),
+            (r'attn/out/kernel$', P('model', None)),
+            (r'fc/kernel$', P(None, 'model')),
+            (r'proj/kernel$', P('model', None)),
+            (r'wte/embedding$', P('model', None)),
+            (r'wpe/embedding$', P(None, 'model')),
+        )
+
+
+def gpt2_small(**overrides) -> GPT2:
+    return GPT2(**overrides)
+
+
+def gpt2_tiny(**overrides) -> GPT2:
+    """Test/dry-run scale: compiles in seconds on CPU."""
+    config = dict(vocab_size=256, layers=2, dim=64, heads=4, max_seq=128,
+                  dropout=0.0)
+    config.update(overrides)
+    return GPT2(**config)

@@ -1,0 +1,59 @@
+"""Attention ops.
+
+The XLA-first implementation: plain einsum attention that the compiler fuses
+and tiles onto the MXU, with softmax accumulated in float32 regardless of the
+activation dtype (bf16-safe). The Pallas flash kernel
+(:mod:`tpusystem.ops.pallas.flash`) and the ring/sequence-parallel variant
+(:mod:`tpusystem.ops.ring`) plug in behind the same signature.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def causal_mask(query_length: int, key_length: int, *, offset: int = 0) -> jax.Array:
+    """Boolean [q, k] mask where True = attend. ``offset`` is the absolute
+    position of the first query (used by ring attention blocks)."""
+    query_positions = jnp.arange(query_length)[:, None] + offset
+    key_positions = jnp.arange(key_length)[None, :]
+    return query_positions >= key_positions
+
+
+def dot_product_attention(query, key, value, *, causal: bool = True,
+                          mask=None, scale: float | None = None,
+                          dropout: float = 0.0, dropout_rng=None):
+    """Multi-head attention over [batch, length, heads, head_dim] tensors.
+
+    Softmax runs in float32; output returns in the input dtype. Supports
+    grouped-query attention: when ``key``/``value`` carry fewer heads than
+    ``query``, KV heads are broadcast over query-head groups (Llama-3 GQA).
+    ``dropout`` > 0 (with ``dropout_rng``) drops attention probabilities.
+    """
+    input_dtype = query.dtype
+    head_dim = query.shape[-1]
+    query_heads = query.shape[2]
+    kv_heads = key.shape[2]
+    scale = scale if scale is not None else head_dim ** -0.5
+
+    if kv_heads != query_heads:
+        assert query_heads % kv_heads == 0, (query_heads, kv_heads)
+        group = query_heads // kv_heads
+        key = jnp.repeat(key, group, axis=2)
+        value = jnp.repeat(value, group, axis=2)
+
+    scores = jnp.einsum('bqhd,bkhd->bhqk', query, key,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        scores = jnp.where(causal_mask(query.shape[1], key.shape[1]),
+                           scores, NEG_INF)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    weights = jax.nn.softmax(scores, axis=-1)
+    if dropout > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout), 0.0)
+    return jnp.einsum('bhqk,bkhd->bqhd', weights.astype(input_dtype), value)

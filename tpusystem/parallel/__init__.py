@@ -1,0 +1,11 @@
+from tpusystem.parallel.mesh import (
+    AXES, DATA, EXPERT, FSDP, MODEL, SEQ, STAGE,
+    MeshSpec, batch_sharding, replicated, single_device_mesh,
+)
+from tpusystem.parallel.sharding import (
+    DataParallel, FullyShardedDataParallel, ShardingPolicy, TensorParallel,
+)
+
+__all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
+           'ShardingPolicy', 'DataParallel', 'FullyShardedDataParallel',
+           'TensorParallel', 'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE']

@@ -1,0 +1,60 @@
+"""Named collective wrappers for ``shard_map`` kernels.
+
+The data plane of the distributed design (SURVEY.md §5): XLA collectives
+over ICI within a slice and DCN across slices. GSPMD inserts most of these
+implicitly from sharding annotations; explicit kernels (ring attention,
+pipeline schedules, MoE dispatch) call these wrappers inside
+``jax.shard_map``. They are thin by design — the value is one documented
+vocabulary with ring-neighbor conventions fixed in a single place.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def all_reduce_sum(value, axis: str):
+    """Sum over every shard on ``axis`` (gradient reduction)."""
+    return lax.psum(value, axis)
+
+
+def all_reduce_mean(value, axis: str):
+    return lax.pmean(value, axis)
+
+
+def all_gather(value, axis: str, *, dimension: int = 0, tiled: bool = True):
+    """Concatenate shards along ``dimension`` (FSDP weight gather)."""
+    return lax.all_gather(value, axis, axis=dimension, tiled=tiled)
+
+
+def reduce_scatter(value, axis: str, *, dimension: int = 0):
+    """Sum then scatter along ``dimension`` (ZeRO gradient scatter)."""
+    return lax.psum_scatter(value, axis, scatter_dimension=dimension, tiled=True)
+
+
+def all_to_all(value, axis: str, *, split_dimension: int, concat_dimension: int):
+    """Shard-transpose (MoE token dispatch, Ulysses head/seq swap)."""
+    return lax.all_to_all(value, axis, split_axis=split_dimension,
+                          concat_axis=concat_dimension, tiled=True)
+
+
+def ring_shift(value, axis: str, *, reverse: bool = False):
+    """Send this shard to the next (or previous) rank on the ring —
+    the ``ppermute`` at the heart of ring attention and 1F1B pipelines.
+    Neighbor convention: rank ``i`` sends to ``(i+1) % n`` when forward.
+    """
+    size = lax.axis_size(axis)
+    if reverse:
+        permutation = [(source, (source - 1) % size) for source in range(size)]
+    else:
+        permutation = [(source, (source + 1) % size) for source in range(size)]
+    return lax.ppermute(value, axis, permutation)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.axis_size(axis)

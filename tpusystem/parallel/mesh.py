@@ -1,0 +1,96 @@
+"""Device meshes — the TPU replacement for CUDA device strings.
+
+The reference resolves ``Depends(device)`` to ``'cuda'``
+(``examples/tinysys/main.py:36-37``); here the injected runtime fact is a
+:class:`jax.sharding.Mesh` laid out over the chip topology. All parallelism
+(DP/FSDP/TP/PP/SP/EP) is expressed as named mesh axes; GSPMD and
+``shard_map`` insert the matching ICI/DCN collectives.
+
+Axis vocabulary (used by every sharding policy and model in the framework):
+
+======== ========================================================
+``data``   pure data parallelism (gradient all-reduce)
+``fsdp``   fully-sharded data parallelism (params/opt-state scatter)
+``model``  tensor parallelism (weight-matrix column/row split)
+``seq``    sequence/context parallelism (ring attention)
+``expert`` expert parallelism (MoE all-to-all dispatch)
+``stage``  pipeline parallelism (collective-permute between stages)
+======== ========================================================
+
+A :class:`MeshSpec` is a registered entity: its axis sizes capture into the
+experiment identity hash, so checkpoints distinguish incompatible layouts
+(SURVEY.md §7.3 "identity under sharding").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpusystem.registry import register
+
+DATA, FSDP, MODEL, SEQ, EXPERT, STAGE = 'data', 'fsdp', 'model', 'seq', 'expert', 'stage'
+AXES = (DATA, FSDP, MODEL, SEQ, EXPERT, STAGE)
+
+
+@register
+class MeshSpec:
+    """Declarative mesh layout: axis name -> size.
+
+    Size ``-1`` on exactly one axis means "fill with all remaining devices".
+    Axes of size 1 are kept in the mesh (they cost nothing and keep
+    PartitionSpecs uniform across configurations).
+
+    Example::
+
+        MeshSpec(data=-1, model=4).build()   # v4-32: data=8 x model=4
+        MeshSpec(fsdp=-1).build()            # pure FSDP over every chip
+    """
+
+    def __init__(self, data: int = 1, fsdp: int = 1, model: int = 1,
+                 seq: int = 1, expert: int = 1, stage: int = 1):
+        self.sizes = {DATA: data, FSDP: fsdp, MODEL: model,
+                      SEQ: seq, EXPERT: expert, STAGE: stage}
+
+    def resolved_sizes(self, device_count: int) -> dict[str, int]:
+        sizes = dict(self.sizes)
+        wildcards = [axis for axis, size in sizes.items() if size == -1]
+        if len(wildcards) > 1:
+            raise ValueError(f'only one axis may be -1, got {wildcards}')
+        fixed = math.prod(size for size in sizes.values() if size != -1)
+        if wildcards:
+            if device_count % fixed:
+                raise ValueError(
+                    f'{device_count} devices not divisible by fixed axes {fixed}')
+            sizes[wildcards[0]] = device_count // fixed
+        elif fixed != device_count:
+            raise ValueError(
+                f'mesh wants {fixed} devices but {device_count} are available')
+        return sizes
+
+    def build(self, devices: Sequence[jax.Device] | None = None) -> Mesh:
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = self.resolved_sizes(len(devices))
+        shape = tuple(sizes[axis] for axis in AXES)
+        return Mesh(np.asarray(devices).reshape(shape), AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A 1x1x1x1x1x1 mesh over one chip — the degenerate case that keeps
+    every sharding annotation valid on a single device."""
+    devices = [device] if device is not None else jax.devices()[:1]
+    return MeshSpec().build(devices)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Canonical global-batch sharding: the batch dimension splits over the
+    combined (data, fsdp) axes — FSDP is data parallelism for activations."""
+    return NamedSharding(mesh, PartitionSpec((DATA, FSDP)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,161 @@
+"""GSPMD sharding policies — parallelism as injectable strategy objects.
+
+The reference has no parallelism at all (SURVEY.md §2.4); this module is
+where the TPU build supplies DP/FSDP/TP as *policy objects* chosen in the
+compiler pipeline (build -> place-on-mesh -> jit). A policy maps every leaf
+of a state pytree to a ``PartitionSpec``:
+
+1. **regex rules** (tensor parallelism): first pattern matching the leaf's
+   ``/``-joined path wins — model families ship their own rule sets
+   (e.g. ``attention/query/kernel -> P('fsdp' if combined else None, 'model')``).
+   Optimizer slot variables (``mu/nu``) contain the parameter path as a
+   suffix, so one rule set covers params *and* optimizer state.
+2. **FSDP inference** (shape-based): any leaf still owning an unsharded
+   dimension divisible by the ``fsdp`` axis size gets its largest such
+   dimension sharded — ZeRO-3-style parameter + optimizer-state scatter
+   with zero per-model configuration.
+
+Placing leaves with the resulting ``NamedSharding`` is all GSPMD needs: the
+jitted step then runs with all-gathers/reduce-scatters inserted over ICI.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from tpusystem.parallel.mesh import DATA, FSDP, MODEL
+from tpusystem.registry import register
+
+Rules = Sequence[tuple[str, PartitionSpec]]
+
+
+def leaf_path(key_path) -> str:
+    """Render a jax key path as ``a/b/0/c`` for regex matching."""
+    parts = []
+    for entry in key_path:
+        if hasattr(entry, 'key'):
+            parts.append(str(entry.key))
+        elif hasattr(entry, 'name'):
+            parts.append(str(entry.name))
+        elif hasattr(entry, 'idx'):
+            parts.append(str(entry.idx))
+        else:
+            parts.append(str(entry))
+    return '/'.join(parts)
+
+
+def _mesh_axis_size(mesh: Mesh, axis) -> int:
+    if axis is None:
+        return 1
+    if isinstance(axis, (tuple, list)):
+        size = 1
+        for name in axis:
+            size *= mesh.shape[name]
+        return size
+    return mesh.shape[axis]
+
+
+def _with_fsdp(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh,
+               min_size: int) -> PartitionSpec:
+    """Add the fsdp axis to the largest unsharded, divisible dimension."""
+    fsdp_size = mesh.shape[FSDP]
+    if fsdp_size == 1:
+        return spec
+    if _leaf_elements(shape) < min_size:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    if any(axis == FSDP or (isinstance(axis, tuple) and FSDP in axis)
+           for axis in entries):
+        return spec
+    candidates = [index for index, axis in enumerate(entries)
+                  if axis is None and shape[index] % fsdp_size == 0]
+    if not candidates:
+        return spec
+    best = max(candidates, key=lambda index: shape[index])
+    entries[best] = FSDP
+    while entries and entries[-1] is None:
+        entries.pop()
+    return PartitionSpec(*entries)
+
+
+def _leaf_elements(shape: tuple[int, ...]) -> int:
+    total = 1
+    for size in shape:
+        total *= size
+    return total
+
+
+@register
+class ShardingPolicy:
+    """Composable sharding strategy.
+
+    Args:
+        rules: ``(regex, PartitionSpec)`` pairs, first match wins. Patterns
+            are ``re.search`` against the leaf path.
+        fsdp: infer fsdp-axis sharding for leaves the rules left unsharded.
+        fsdp_min_size: leaves with fewer elements stay replicated (tiny
+            tensors cost more to gather than they save).
+    """
+
+    def __init__(self, rules: Rules = (), fsdp: bool = False,
+                 fsdp_min_size: int = 4096):
+        self.rules = [(re.compile(pattern), spec) for pattern, spec in rules]
+        self.fsdp = fsdp
+        self.fsdp_min_size = fsdp_min_size
+
+    def spec(self, path: str, shape: tuple[int, ...], mesh: Mesh) -> PartitionSpec:
+        chosen = PartitionSpec()
+        for pattern, spec in self.rules:
+            if pattern.search(path):
+                chosen = spec
+                break
+        # drop rule axes that don't divide the dimension (e.g. seq axis on a
+        # mesh where seq=1 always divides; model axis on an odd head count)
+        entries = list(chosen) + [None] * (len(shape) - len(chosen))
+        entries = [axis if axis is None or shape[index] % _mesh_axis_size(mesh, axis) == 0
+                   else None
+                   for index, axis in enumerate(entries)]
+        while entries and entries[-1] is None:
+            entries.pop()
+        chosen = PartitionSpec(*entries)
+        if self.fsdp:
+            chosen = _with_fsdp(chosen, shape, mesh, self.fsdp_min_size)
+        return chosen
+
+    def tree_specs(self, tree: Any, mesh: Mesh) -> Any:
+        """PartitionSpec pytree matching ``tree``'s structure."""
+        def assign(key_path, leaf):
+            shape = getattr(leaf, 'shape', ())
+            return self.spec(leaf_path(key_path), tuple(shape), mesh)
+        return jax.tree_util.tree_map_with_path(assign, tree)
+
+    def tree_shardings(self, tree: Any, mesh: Mesh) -> Any:
+        return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
+                            self.tree_specs(tree, mesh))
+
+    def place(self, tree: Any, mesh: Mesh) -> Any:
+        """Materialize the tree on the mesh with this policy's shardings."""
+        return jax.device_put(tree, self.tree_shardings(tree, mesh))
+
+
+def DataParallel() -> ShardingPolicy:
+    """Replicate parameters everywhere; shard only the batch. The gradient
+    all-reduce over ICI is inserted by GSPMD from the batch sharding."""
+    return ShardingPolicy(rules=(), fsdp=False)
+
+
+def FullyShardedDataParallel(min_size: int = 4096) -> ShardingPolicy:
+    """ZeRO-3 equivalent: parameters and optimizer slots scattered over the
+    ``fsdp`` axis, gathered just-in-time per layer by GSPMD."""
+    return ShardingPolicy(rules=(), fsdp=True, fsdp_min_size=min_size)
+
+
+def TensorParallel(rules: Rules, fsdp: bool = False,
+                   fsdp_min_size: int = 4096) -> ShardingPolicy:
+    """Megatron-style weight splitting from model-supplied rules, optionally
+    combined with FSDP on the remaining dimensions."""
+    return ShardingPolicy(rules=rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size)
