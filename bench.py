@@ -40,7 +40,7 @@ def main() -> None:
     from tpusystem.models import GPT2
     from tpusystem.train import AdamW, NextTokenLoss, build_train_step, flax_apply, init_state
 
-    batch, seq = 8, 1024
+    batch, seq = 16, 1024
     module = GPT2(dropout=0.0)
     optimizer = AdamW(lr=3e-4, grad_clip=1.0)
     tokens = jnp.asarray(

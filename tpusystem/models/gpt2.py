@@ -23,19 +23,38 @@ class SelfAttention(nn.Module):
     heads: int
     dropout: float
     dtype: jnp.dtype
+    kernel: str = 'flash'  # 'flash' (Pallas) | 'xla' | 'ring' | 'ulysses'
+    mesh: object = None    # required for 'ring'/'ulysses' (seq-sharded)
+    attn_dropout: float = 0.0  # attention-probability dropout; 'xla' only
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
+        if self.attn_dropout and self.kernel != 'xla':
+            raise ValueError(
+                "attention-probability dropout is only implemented on the "
+                f"'xla' kernel, not {self.kernel!r}")
         dim = hidden.shape[-1]
         head_dim = dim // self.heads
         qkv = nn.Dense(3 * dim, dtype=self.dtype, name='qkv')(hidden)
         query, key, value = jnp.split(qkv, 3, axis=-1)
         shape = hidden.shape[:2] + (self.heads, head_dim)
-        context = dot_product_attention(
-            query.reshape(shape), key.reshape(shape), value.reshape(shape),
-            causal=True,
-            dropout=self.dropout if train else 0.0,
-            dropout_rng=self.make_rng('dropout') if train and self.dropout else None)
+        query, key, value = (t.reshape(shape) for t in (query, key, value))
+        if self.kernel == 'flash':
+            from tpusystem.ops.pallas.flash import flash_attention
+            context = flash_attention(query, key, value, causal=True)
+        elif self.kernel in ('ring', 'ulysses'):
+            from tpusystem.ops.ring import ring_self_attention
+            assert self.mesh is not None, 'ring/ulysses attention needs a mesh'
+            context = ring_self_attention(query, key, value, self.mesh,
+                                          causal=True, variant=self.kernel)
+        elif self.kernel == 'xla':
+            context = dot_product_attention(
+                query, key, value, causal=True,
+                dropout=self.attn_dropout if train else 0.0,
+                dropout_rng=self.make_rng('dropout') if train and self.attn_dropout else None)
+        else:
+            raise ValueError(f'unknown attention kernel {self.kernel!r}; '
+                             "expected 'flash', 'xla', 'ring' or 'ulysses'")
         context = context.reshape(hidden.shape)
         return nn.Dense(dim, dtype=self.dtype, name='out')(context)
 
@@ -45,12 +64,18 @@ class Block(nn.Module):
     mlp_ratio: int
     dropout: float
     dtype: jnp.dtype
+    attention: str = 'flash'
+    mesh: object = None
+    attn_dropout: float = 0.0
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
         dim = hidden.shape[-1]
         normed = nn.LayerNorm(dtype=jnp.float32, name='ln_1')(hidden)
-        attended = SelfAttention(self.heads, self.dropout, self.dtype, name='attn')(
+        attended = SelfAttention(self.heads, self.dropout, self.dtype,
+                                 kernel=self.attention, mesh=self.mesh,
+                                 attn_dropout=self.attn_dropout,
+                                 name='attn')(
             normed.astype(self.dtype), train)
         attended = nn.Dropout(self.dropout, deterministic=not train)(attended)
         hidden = hidden + attended
@@ -63,7 +88,6 @@ class Block(nn.Module):
         return hidden + shrunk
 
 
-@register
 class GPT2(nn.Module):
     """Decoder-only transformer with learned positions and tied LM head.
 
@@ -78,6 +102,10 @@ class GPT2(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.1
     dtype: str = 'bfloat16'
+    attention: str = 'flash'  # 'flash' | 'xla' | 'ring' | 'ulysses'
+    mesh: object = None  # mesh for ring/ulysses sequence parallelism
+    attn_dropout: float = 0.0  # attention-prob dropout (opt-in, 'xla' only)
+    remat: bool = False  # recompute each block's activations in backward
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -92,9 +120,12 @@ class GPT2(nn.Module):
         hidden = hidden.astype(compute_dtype)
         assert tokens.shape[-1] <= self.max_seq, (
             f'sequence length {tokens.shape[-1]} exceeds max_seq={self.max_seq}')
+        block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
         for index in range(self.layers):
-            hidden = Block(self.heads, self.mlp_ratio, self.dropout,
-                           compute_dtype, name=f'h_{index}')(hidden, train)
+            hidden = block_cls(self.heads, self.mlp_ratio, self.dropout,
+                               compute_dtype, attention=self.attention,
+                               mesh=self.mesh, attn_dropout=self.attn_dropout,
+                               name=f'h_{index}')(hidden, train)
         hidden = nn.LayerNorm(dtype=jnp.float32, name='ln_f')(hidden)
         # tied LM head: logits against the token embedding table, f32 for
         # a numerically stable softmax/loss
@@ -115,6 +146,9 @@ class GPT2(nn.Module):
             (r'wte/embedding$', P('model', None)),
             (r'wpe/embedding$', P(None, 'model')),
         )
+
+
+register(GPT2, excluded_kwargs={'mesh'})
 
 
 def gpt2_small(**overrides) -> GPT2:

@@ -1,0 +1,311 @@
+"""Flash attention — Pallas TPU kernel.
+
+Blockwise-online-softmax attention: O(seq) memory instead of the O(seq^2)
+scores tensor that XLA attention materializes (the allocation that caps
+single-chip GPT-2 batch size). Forward and backward are hand-written
+kernels; the public entry :func:`flash_attention` carries a ``custom_vjp``
+so ``jax.grad`` works transparently.
+
+Kernel shape notes (see /opt/skills/guides/pallas_guide.md):
+* grid iterates (batch*heads, q_block, kv_block) with the kv dimension
+  innermost — running max/sum/accumulator live in VMEM scratch across the
+  kv sweep and the output block is written once on the final kv step;
+* softmax statistics are kept as (block_q, 128) f32 tiles (lane-replicated)
+  to match the VPU tile shape;
+* causal blocks strictly above the diagonal are skipped via predication;
+  the diagonal block applies a triangular mask from 2D broadcasted_iota;
+* logsumexp is saved for the backward pass, which recomputes P blockwise
+  (dq kernel sweeps kv; dk/dv kernel sweeps q innermost).
+
+``interpret=True`` runs the same kernels in interpreter mode for CPU tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      m_scr, l_scr, acc_scr,
+                      *, scale: float, causal: bool,
+                      block_q: int, block_kv: int):
+    q_idx, kv_idx = pl.program_id(1), pl.program_id(2)
+    kv_steps = pl.num_programs(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: skip blocks strictly above the diagonal
+    compute = (not causal) or (q_idx * block_q + block_q - 1 >= kv_idx * block_kv)
+
+    @pl.when(compute if isinstance(compute, bool) else compute)
+    def _block():
+        query = q_ref[0]                      # (block_q, head_dim)
+        key = k_ref[0]                        # (block_kv, head_dim)
+        value = v_ref[0]
+        scores = jax.lax.dot_general(
+            query, key, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (block_q, block_kv)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) + q_idx * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + kv_idx * block_kv
+            scores = jnp.where(rows >= cols, scores, NEG_INF)
+
+        m_prev = m_scr[:, :1]                               # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        probs = jnp.exp(scores - m_new)                     # (block_q, block_kv)
+        correction = jnp.exp(m_prev - m_new)                # (block_q, 1)
+        l_new = correction * l_scr[:, :1] + jnp.sum(probs, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * correction + jax.lax.dot_general(
+            probs.astype(value.dtype), value, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(kv_idx == kv_steps - 1)
+    def _finish():
+        l_final = l_scr[:, :1]
+        safe_l = jnp.where(l_final == 0.0, 1.0, l_final)
+        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse = m_scr[:, :1] + jnp.log(safe_l)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     dq_scr, *, scale: float, causal: bool,
+                     block_q: int, block_kv: int):
+    q_idx, kv_idx = pl.program_id(1), pl.program_id(2)
+    kv_steps = pl.num_programs(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    compute = (not causal) or (q_idx * block_q + block_q - 1 >= kv_idx * block_kv)
+
+    @pl.when(compute if isinstance(compute, bool) else compute)
+    def _block():
+        query, key, value = q_ref[0], k_ref[0], v_ref[0]
+        grad_out = do_ref[0]
+        scores = jax.lax.dot_general(
+            query, key, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) + q_idx * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + kv_idx * block_kv
+            scores = jnp.where(rows >= cols, scores, NEG_INF)
+        probs = jnp.exp(scores - lse_ref[0][:, :1])
+        dprobs = jax.lax.dot_general(
+            grad_out, value, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dscores = probs * (dprobs - delta_ref[0][:, :1]) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            dscores.astype(key.dtype), key, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == kv_steps - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_scr, dv_scr,
+                      *, scale: float, causal: bool,
+                      block_q: int, block_kv: int):
+    kv_idx, q_idx = pl.program_id(1), pl.program_id(2)
+    q_steps = pl.num_programs(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    compute = (not causal) or (q_idx * block_q + block_q - 1 >= kv_idx * block_kv)
+
+    @pl.when(compute if isinstance(compute, bool) else compute)
+    def _block():
+        query, key, value = q_ref[0], k_ref[0], v_ref[0]
+        grad_out = do_ref[0]
+        scores = jax.lax.dot_general(
+            query, key, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) + q_idx * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + kv_idx * block_kv
+            scores = jnp.where(rows >= cols, scores, NEG_INF)
+        probs = jnp.exp(scores - lse_ref[0][:, :1])           # (bq, bkv)
+        dv_scr[...] += jax.lax.dot_general(
+            probs.astype(grad_out.dtype), grad_out, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bkv, d)
+        dprobs = jax.lax.dot_general(
+            grad_out, value, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dscores = probs * (dprobs - delta_ref[0][:, :1]) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            dscores.astype(query.dtype), query, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == q_steps - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _block_sizes(seq_q: int, seq_kv: int, block_q: int, block_kv: int):
+    block_q = min(block_q, seq_q)
+    block_kv = min(block_kv, seq_kv)
+    if seq_q % block_q or seq_kv % block_kv:
+        return None
+    return block_q, block_kv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    """q/k/v: [BH, S, D]. Returns (out, residuals)."""
+    bh, seq_q, head_dim = q.shape
+    seq_kv = k.shape[1]
+    grid = (bh, seq_q // block_q, seq_kv // block_kv)
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda i, j, k_: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, LANES), jnp.float32),
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_kv, interpret, residuals, grad_out):
+    q, k, v, out, lse = residuals
+    bh, seq_q, head_dim = q.shape
+    seq_kv = k.shape[1]
+    delta = jnp.sum(grad_out.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                    # (bh, sq, 1)
+    delta = jnp.broadcast_to(delta, (bh, seq_q, LANES))
+
+    dq_kernel = functools.partial(
+        _flash_dq_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, seq_q // block_q, seq_kv // block_kv),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda i, j, k_: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, grad_out, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _flash_dkv_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, seq_kv // block_kv, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda i, k_, j: (i, j, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda i, k_, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda i, k_, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda i, k_, j: (i, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
+            pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_kv, head_dim), jnp.float32),
+            pltpu.VMEM((block_kv, head_dim), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, grad_out, lse, delta)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(query, key, value, *, causal: bool = True,
+                    scale: float | None = None,
+                    block_q: int = 256, block_kv: int = 512,
+                    interpret: bool | None = None):
+    """Flash attention over [batch, length, heads, head_dim] tensors.
+
+    Drop-in for :func:`tpusystem.ops.attention.dot_product_attention`
+    (GQA supported via KV-head broadcast). Falls back to the XLA path when
+    the sequence length does not divide the block sizes.
+    ``interpret=None`` auto-selects interpreter mode off-TPU so the same
+    model code runs in CPU tests.
+    """
+    from tpusystem.ops.attention import dot_product_attention
+
+    if interpret is None:
+        interpret = jax.default_backend() not in ('tpu', 'axon')
+
+    batch, seq_q, q_heads, head_dim = query.shape
+    kv_heads = key.shape[2]
+    if kv_heads != q_heads:
+        group = q_heads // kv_heads
+        key = jnp.repeat(key, group, axis=2)
+        value = jnp.repeat(value, group, axis=2)
+    scale = scale if scale is not None else head_dim ** -0.5
+
+    sizes = _block_sizes(seq_q, key.shape[1], block_q, block_kv)
+    if sizes is None:
+        return dot_product_attention(query, key, value, causal=causal, scale=scale)
+    block_q, block_kv = sizes
+
+    def to_bh(tensor):  # [B,S,H,D] -> [B*H, S, D]
+        return tensor.transpose(0, 2, 1, 3).reshape(-1, tensor.shape[1], head_dim)
+
+    out = _flash(to_bh(query), to_bh(key), to_bh(value),
+                 causal, scale, block_q, block_kv, interpret)
+    return out.reshape(batch, q_heads, seq_q, head_dim).transpose(0, 2, 1, 3)

@@ -1,0 +1,145 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+Long-context capability (SURVEY.md §2.4/§5): queries stay put while K/V
+chunks rotate around the ICI ring via ``ppermute``; each device accumulates
+blockwise-softmax partial results, so a sequence of length S costs each
+device O(S/n) memory and the full S^2 attention FLOPs are spread n ways.
+
+Two variants:
+
+* :func:`ring_attention` — the ppermute ring, callable **inside**
+  ``shard_map`` on seq-sharded [B, S/n, H, D] chunks. Differentiable
+  (``ppermute`` has a transpose rule), so ``jax.grad`` works through it.
+* :func:`ulysses_attention` — the all-to-all head/sequence swap (DeepSpeed
+  Ulysses): transposes shards so each device holds *all* positions for a
+  subset of heads, runs dense/flash attention locally, swaps back. Cheaper
+  collectives for moderate contexts; requires heads % ring_size == 0.
+
+The outer convenience :func:`ring_self_attention` wires the ``shard_map``
+over a mesh for both.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpusystem.parallel.mesh import DATA, FSDP, SEQ
+
+NEG_INF = -1e30
+
+
+def _chunk_scores(query, key, scale, q_offset, kv_offset, causal):
+    """Masked f32 scores for one (q-chunk, kv-chunk) pair."""
+    scores = jnp.einsum('bqhd,bkhd->bhqk', query, key,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_positions = jnp.arange(query.shape[1])[:, None] + q_offset
+        k_positions = jnp.arange(key.shape[1])[None, :] + kv_offset
+        scores = jnp.where(q_positions >= k_positions, scores, NEG_INF)
+    return scores
+
+
+def ring_attention(query, key, value, *, axis: str = SEQ, causal: bool = True,
+                   scale: float | None = None):
+    """Blockwise ring attention. Call inside ``shard_map``.
+
+    Args:
+        query/key/value: local chunks [batch, chunk, heads, head_dim] of a
+            sequence sharded over ``axis``.
+    Returns:
+        local output chunk [batch, chunk, heads, head_dim].
+    """
+    ring = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    chunk = query.shape[1]
+    head_dim = query.shape[-1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    q_offset = rank * chunk
+
+    batch, _, heads, _ = query.shape
+    running_max = jnp.full((batch, heads, chunk, 1), NEG_INF, jnp.float32)
+    running_sum = jnp.zeros((batch, heads, chunk, 1), jnp.float32)
+    accumulator = jnp.zeros((batch, chunk, heads, head_dim), jnp.float32)
+
+    def permute(tensor):
+        size = lax.axis_size(axis)
+        return lax.ppermute(
+            tensor, axis,
+            [(source, (source + 1) % size) for source in range(size)])
+
+    for step in range(ring):
+        owner = (rank - step) % ring          # whose chunk we currently hold
+        kv_offset = owner * chunk
+        scores = _chunk_scores(query, key, scale, q_offset, kv_offset, causal)
+        chunk_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_max = jnp.maximum(running_max, chunk_max)
+        probs = jnp.exp(scores - new_max)
+        correction = jnp.exp(running_max - new_max)
+        running_sum = running_sum * correction + jnp.sum(probs, -1, keepdims=True)
+        partial = jnp.einsum('bhqk,bkhd->bqhd', probs.astype(value.dtype), value,
+                             preferred_element_type=jnp.float32)
+        accumulator = (accumulator
+                       * correction.transpose(0, 2, 1, 3)
+                       + partial)
+        running_max = new_max
+        if step != ring - 1:
+            key = permute(key)
+            value = permute(value)
+
+    safe_sum = jnp.where(running_sum == 0.0, 1.0, running_sum)
+    normalized = accumulator / safe_sum.transpose(0, 2, 1, 3)
+    return normalized.astype(query.dtype)
+
+
+def ulysses_attention(query, key, value, *, axis: str = SEQ,
+                      causal: bool = True, scale: float | None = None):
+    """All-to-all sequence parallelism. Call inside ``shard_map``.
+
+    Local [B, S/n, H, D] chunks are shard-transposed to [B, S, H/n, D]
+    (full sequence, head subset), attended densely, and transposed back.
+    """
+    ring = lax.axis_size(axis)
+    heads = query.shape[2]
+    assert heads % ring == 0, (
+        f'ulysses needs heads ({heads}) divisible by the seq axis ({ring})')
+
+    def swap_in(tensor):   # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(tensor, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def swap_out(tensor):  # [B, S, H/n, D] -> [B, S/n, H, D]
+        return lax.all_to_all(tensor, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    from tpusystem.ops.attention import dot_product_attention
+    out = dot_product_attention(swap_in(query), swap_in(key), swap_in(value),
+                                causal=causal, scale=scale)
+    return swap_out(out)
+
+
+def ring_self_attention(query, key, value, mesh, *, causal: bool = True,
+                        variant: str = 'ring'):
+    """Convenience wrapper: shard_map the chosen variant over ``mesh``.
+
+    Inputs are global [B, S, H, D]; batch shards over (data, fsdp), sequence
+    over seq. Useful standalone and as the reference harness for tests.
+    """
+    implementation = {'ring': ring_attention, 'ulysses': ulysses_attention}[variant]
+    data_parallel = mesh.shape[DATA] * mesh.shape[FSDP]
+    # batch shards over (data, fsdp) when divisible (e.g. module.init traces
+    # with batch 1 — replicate batch there, shard only the sequence)
+    batch_axes = (DATA, FSDP) if query.shape[0] % data_parallel == 0 else None
+    spec = P(batch_axes, SEQ, None, None)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec)
+    def mapped(q, k, v):
+        return implementation(q, k, v, causal=causal)
+
+    return mapped(query, key, value)

@@ -59,6 +59,10 @@ def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
     ``optimizer`` is a :class:`tpusystem.train.optim.Optimizer` or a raw
     ``optax.GradientTransformation``. The returned step donates ``state``:
     callers must treat the passed-in state as consumed.
+
+    For activation rematerialisation use per-layer checkpointing at the
+    model level (e.g. ``GPT2(remat=True)``) — whole-forward checkpointing
+    here would double FLOPs without reducing backward peak memory.
     """
     transform = optimizer.transform() if hasattr(optimizer, 'transform') else optimizer
 
