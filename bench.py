@@ -41,7 +41,7 @@ def main() -> None:
     from tpusystem.train import AdamW, NextTokenLoss, build_train_step, flax_apply, init_state
 
     batch, seq = 16, 1024
-    module = GPT2(dropout=0.0)
+    module = GPT2(dropout=0.0, attention='flash')  # single chip: Pallas kernel
     optimizer = AdamW(lr=3e-4, grad_clip=1.0)
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, module.vocab_size, (batch, seq)),

@@ -97,10 +97,14 @@ def test_sequence_parallel_gradients(qkv, variant):
         return jnp.mean(ring_self_attention(q, k, v, mesh, causal=True,
                                             variant=variant) ** 2)
 
-    grads_single = jax.grad(loss_single)(q, k, v)
-    grads_sharded = jax.grad(loss_sharded)(q, k, v)
-    np.testing.assert_allclose(np.asarray(grads_single),
-                               np.asarray(grads_sharded), atol=5e-5)
+    # argnums=(0,1,2): dK/dV exercise the transpose of the rotating-K/V
+    # collectives (ppermute ring reversal / all_to_all axis swap), where a
+    # direction bug would leave dQ correct but dK/dV permuted.
+    grads_single = jax.grad(loss_single, argnums=(0, 1, 2))(q, k, v)
+    grads_sharded = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    for single, sharded in zip(grads_single, grads_sharded):
+        np.testing.assert_allclose(np.asarray(single), np.asarray(sharded),
+                                   atol=5e-5)
 
 
 def test_ring_noncausal():

@@ -1,0 +1,122 @@
+"""Checkpoint/resume: async sharded saves, identity-keyed restore.
+
+Mirrors the reference's repository semantics (store/restore by id,
+resume-by-identity — ``examples/tinysys/tinysys/repository.py``,
+``.../services/compilation.py:41-64``) plus what the reference lacks:
+sharded restore onto a live device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpusystem.checkpoint import Checkpointer, Repository
+from tpusystem.models import MLP
+from tpusystem.registry import gethash
+from tpusystem.train import Adam, init_state
+
+
+@pytest.fixture()
+def state():
+    module = MLP(features=(16,), classes=10)
+    return init_state(module, Adam(lr=1e-3), jnp.zeros((4, 28, 28)), rng=0)
+
+
+def test_save_restore_roundtrip(tmp_path, state):
+    with Checkpointer(tmp_path, async_save=False) as ckpt:
+        ckpt.save('model-a', 0, state)
+        blank = jax.tree.map(jnp.zeros_like, state)
+        restored = ckpt.restore('model-a', blank)
+    for original, loaded in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(original), np.asarray(loaded))
+
+
+def test_latest_and_epochs_track_versions(tmp_path, state):
+    with Checkpointer(tmp_path, async_save=False, max_to_keep=None) as ckpt:
+        assert ckpt.latest('m') is None
+        for epoch in (0, 1, 2):
+            ckpt.save('m', epoch, state)
+        assert ckpt.latest('m') == 2
+        assert ckpt.epochs('m') == [0, 1, 2]
+        # identities are isolated
+        assert ckpt.latest('other') is None
+
+
+def test_restore_missing_identity_raises(tmp_path, state):
+    with Checkpointer(tmp_path, async_save=False) as ckpt:
+        with pytest.raises(FileNotFoundError):
+            ckpt.restore('nope', state)
+
+
+def test_async_save_commits_after_wait(tmp_path, state):
+    with Checkpointer(tmp_path, async_save=True) as ckpt:
+        ckpt.save('m', 0, state)
+        ckpt.wait()
+        assert ckpt.latest('m') == 0
+
+
+def test_restore_onto_sharded_target(tmp_path, state):
+    """Weights saved unsharded restore directly onto a mesh layout —
+    checkpoint portability across topologies (SURVEY.md §5 checkpoint)."""
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(8), ('data',))
+    with Checkpointer(tmp_path, async_save=False) as ckpt:
+        ckpt.save('m', 0, state)
+        replicated = NamedSharding(mesh, P())
+        target = jax.tree.map(
+            lambda leaf: jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=replicated),
+            state)
+        restored = ckpt.restore('m', target)
+    leaf = jax.tree.leaves(restored)[0]
+    assert leaf.sharding.is_equivalent_to(replicated, leaf.ndim)
+    np.testing.assert_array_equal(
+        np.asarray(jax.tree.leaves(restored)[0]), np.asarray(jax.tree.leaves(state)[0]))
+
+
+class FakeAggregate:
+    def __init__(self, state, identity='agg'):
+        self.state = state
+        self._id = identity
+
+    @property
+    def id(self):
+        return self._id
+
+
+def test_repository_store_restore_by_identity(tmp_path, state):
+    aggregate = FakeAggregate(state, identity=gethash(MLP(features=(16,), classes=10)))
+    repository = Repository(tmp_path, async_save=False)
+    try:
+        repository.store(aggregate, epoch=0)
+        trained = jax.tree.map(lambda leaf: leaf + 1, state)
+        aggregate.state = trained
+        repository.store(aggregate, epoch=1)
+        assert repository.latest(aggregate) == 1
+
+        # fresh process: same hyperparameters -> same id -> same checkpoint
+        clone = FakeAggregate(jax.tree.map(jnp.zeros_like, state),
+                              identity=gethash(MLP(features=(16,), classes=10)))
+        repository.restore(clone)
+        for expected, loaded in zip(jax.tree.leaves(trained), jax.tree.leaves(clone.state)):
+            np.testing.assert_array_equal(np.asarray(expected), np.asarray(loaded))
+
+        repository.restore(clone, epoch=0)
+        for expected, loaded in zip(jax.tree.leaves(state), jax.tree.leaves(clone.state)):
+            np.testing.assert_array_equal(np.asarray(expected), np.asarray(loaded))
+    finally:
+        repository.close()
+
+
+def test_repository_auto_epoch_increments(tmp_path, state):
+    aggregate = FakeAggregate(state)
+    repository = Repository(tmp_path, async_save=False)
+    try:
+        repository.store(aggregate)   # no epoch attr -> version 0
+        repository.store(aggregate)   # -> version 1
+        assert repository.latest(aggregate) == 1
+        aggregate.epoch = 7
+        repository.store(aggregate)   # uses aggregate.epoch
+        assert repository.latest(aggregate) == 7
+    finally:
+        repository.close()

@@ -1,0 +1,195 @@
+"""Observability consumers: logging, TensorBoard event files, tracking.
+
+Test pattern is the reference's canonical one
+(``examples/tinysys/tests/test_storage.py:33-66``): forge events directly,
+point DI overrides at test fixtures, assert the stored state — the
+framework itself is never mocked.
+"""
+
+import logging
+import struct
+
+import pytest
+
+from tpusystem.checkpoint import Repository
+from tpusystem.observe import (
+    Iterated, StepTimed, SummaryWriter, Trained, Validated,
+    logging_consumer, tensorboard_consumer, tracking_consumer,
+)
+from tpusystem.observe import tensorboard as tensorboard_module
+from tpusystem.observe import tracking
+from tpusystem.storage import (
+    DocumentIterations, DocumentMetrics, DocumentModels, DocumentModules,
+    DocumentStore,
+)
+
+
+class Model:
+    """Host-side stand-in satisfying the aggregate surface consumers use."""
+
+    def __init__(self, identity='hash-1', epoch=3):
+        self.id = identity
+        self.epoch = epoch
+        self.state = {'w': [1.0, 2.0]}
+        self._parts = {}
+
+    def modules(self):
+        return self._parts
+
+
+def test_logging_consumer_reports_each_event(caplog):
+    consumer = logging_consumer()
+    model = Model()
+    with caplog.at_level(logging.INFO, logger='tpusystem'):
+        consumer.consume(Trained(model, {'loss': 0.5}))
+        consumer.consume(Validated(model, {'accuracy': 0.9}))
+        consumer.consume(Iterated(model))
+        consumer.consume(StepTimed(model, 'train', steps=100, seconds=2.0))
+    text = caplog.text
+    assert 'loss: 0.5000' in text and 'accuracy: 0.9000' in text
+    assert 'hash-1' in text and '50.0 steps/s' in text
+
+
+# --- minimal TFRecord/Event readers to verify the on-disk format ---------
+
+def read_records(path):
+    records = []
+    with open(path, 'rb') as handle:
+        while header := handle.read(8):
+            (length,) = struct.unpack('<Q', header)
+            handle.read(4)                      # length crc
+            records.append(handle.read(length))
+            handle.read(4)                      # payload crc
+    return records
+
+
+def parse_scalars(record):
+    """Extract {tag: (value, step)} from a serialized Event proto."""
+    import io
+    scalars = {}
+
+    def varint(stream):
+        shift = result = 0
+        while True:
+            byte = stream.read(1)[0]
+            result |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                return result
+            shift += 7
+
+    def walk(data, step):
+        stream = io.BytesIO(data)
+        fields = {}
+        while stream.tell() < len(data):
+            key = varint(stream)
+            field, wire = key >> 3, key & 7
+            if wire == 0:
+                fields[field] = varint(stream)
+            elif wire == 1:
+                fields[field] = struct.unpack('<d', stream.read(8))[0]
+            elif wire == 5:
+                fields[field] = struct.unpack('<f', stream.read(4))[0]
+            elif wire == 2:
+                fields.setdefault(field, []).append(stream.read(varint(stream)))
+        return fields
+
+    top = walk(record, 0)
+    step = top.get(2, 0)
+    for summary in top.get(5, []):
+        for value in walk(summary, step).get(1, []):
+            fields = walk(value, step)
+            tag = fields[1][0].decode()
+            scalars[tag] = (fields[2], step)
+    return scalars
+
+
+def test_summary_writer_emits_valid_tfrecord_events(tmp_path):
+    writer = SummaryWriter(tmp_path / 'run')
+    writer.add_scalar('loss', 0.25, step=7)
+    writer.add_scalars('metrics', {'a': 1.0, 'b': 2.0}, step=8)
+    writer.close()
+    (event_file,) = list((tmp_path / 'run').iterdir())
+    records = read_records(event_file)
+    assert len(records) == 4                      # version + 3 scalars
+    assert b'brain.Event:2' in records[0]
+    scalars = {}
+    for record in records[1:]:
+        scalars.update(parse_scalars(record))
+    assert scalars['loss'] == (0.25, 7)
+    assert scalars['metrics/a'] == (1.0, 8) and scalars['metrics/b'] == (2.0, 8)
+
+
+def test_tensorboard_consumer_charts_per_phase(tmp_path):
+    consumer = tensorboard_consumer()
+    writer = SummaryWriter(tmp_path / 'run')
+    consumer.dependency_overrides[tensorboard_module.writer] = lambda: writer
+    model = Model(identity='m1', epoch=2)
+    consumer.consume(Trained(model, {'loss': 0.5}))
+    consumer.consume(Validated(model, {'loss': 0.4}))
+    writer.close()
+    (event_file,) = list((tmp_path / 'run').iterdir())
+    scalars = {}
+    for record in read_records(event_file)[1:]:
+        scalars.update(parse_scalars(record))
+    assert scalars['m1/loss/train'] == (0.5, 2)
+    value, step = scalars['m1/loss/evaluation']
+    assert value == pytest.approx(0.4) and step == 2
+
+
+@pytest.fixture()
+def tracked(tmp_path):
+    store = DocumentStore(tmp_path / 'db.json')
+    consumer = tracking_consumer()
+    fixtures = {
+        'metrics': DocumentMetrics(store),
+        'models': DocumentModels(store),
+        'modules': DocumentModules(store),
+        'iterations': DocumentIterations(store),
+        'repository': Repository(tmp_path / 'weights', async_save=False),
+    }
+    overrides = consumer.dependency_overrides
+    overrides[tracking.metrics_store] = lambda: fixtures['metrics']
+    overrides[tracking.models_store] = lambda: fixtures['models']
+    overrides[tracking.modules_store] = lambda: fixtures['modules']
+    overrides[tracking.iterations_store] = lambda: fixtures['iterations']
+    overrides[tracking.repository] = lambda: fixtures['repository']
+    overrides[tracking.experiment] = lambda: 'exp-test'
+    yield consumer, fixtures
+    fixtures['repository'].close()
+
+
+def test_tracking_consumer_persists_metrics_and_epoch(tracked):
+    consumer, fixtures = tracked
+    model = Model(identity='m1', epoch=4)
+    consumer.consume(Trained(model, {'loss': 0.33}))
+    consumer.consume(Validated(model, {'loss': 0.44, 'accuracy': 0.9}))
+    rows = fixtures['metrics'].list('m1')
+    assert {(r.name, r.phase) for r in rows} == {
+        ('loss', 'train'), ('loss', 'evaluation'), ('accuracy', 'evaluation')}
+    assert all(r.epoch == 4 for r in rows)
+
+    consumer.consume(Iterated(model))
+    assert fixtures['models'].read('m1', 'exp-test').epoch == 4
+
+
+def test_tracking_consumer_persists_module_metadata_and_weights(tracked):
+    from tpusystem.models import MLP
+    from tpusystem.data import Loader, SyntheticDigits
+
+    consumer, fixtures = tracked
+    model = Model(identity='m2', epoch=1)
+    network = MLP(features=(8,), classes=4)
+    model._parts = {'nn': network, 'criterion': object()}
+    loader = Loader(SyntheticDigits(samples=16, seed=0), batch_size=4)
+    consumer.consume(Iterated(model, loaders={'train': loader}))
+
+    by_kind = {row.kind: row for row in fixtures['modules'].list('m2')}
+    assert by_kind['nn'].name == 'MLP'
+    assert by_kind['nn'].arguments == {'features': (8,), 'classes': 4}
+    assert by_kind['criterion'].hash is None   # unregistered degrades to name
+
+    (iteration,) = fixtures['iterations'].list('m2')
+    assert iteration.phase == 'train' and iteration.name == 'Loader'
+
+    # weights snapshotted under the aggregate id at its epoch
+    assert fixtures['repository'].latest(model) == 1

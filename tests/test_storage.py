@@ -1,0 +1,104 @@
+"""Storage subsystem: document store + port adapters.
+
+Mirrors the reference's DAO tests (``examples/tinysys/tests/test_daos.py``):
+CRUD of every adapter, the latest-hash upsert dedupe of ``Modules.put``
+(``adapters/modules.py:33-41``) and the phase-keyed upsert of
+``Iterations.put`` (``adapters/iterations.py:22-29``).
+"""
+
+import pytest
+
+from tpusystem.storage import (
+    DocumentExperiments, DocumentIterations, DocumentMetrics, DocumentModels,
+    DocumentModules, DocumentStore, Experiment, Iteration, Metric, Model,
+    Module,
+)
+from tpusystem.storage.documents import where
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return DocumentStore(tmp_path / 'db.json')
+
+
+def test_documents_crud_and_persistence(tmp_path):
+    store = DocumentStore(tmp_path / 'db.json')
+    table = store.table('things')
+    first = table.insert({'name': 'a', 'value': 1})
+    table.insert({'name': 'b', 'value': 2})
+    assert first == 1
+    assert len(table) == 2
+    assert table.get(where(name='a'))['value'] == 1
+    table.update({'value': 10}, where(name='a'))
+    assert table.get(where(name='a'))['value'] == 10
+    table.remove(where(name='b'))
+    assert len(table) == 1
+
+    # reopen from disk: contents and id counters survive
+    reopened = DocumentStore(tmp_path / 'db.json')
+    assert reopened.table('things').get(where(name='a'))['value'] == 10
+    assert reopened.table('things').insert({'name': 'c'}) == 3
+
+
+def test_experiments_create_is_idempotent(store):
+    experiments = DocumentExperiments(store)
+    first = experiments.create(Experiment(name='mnist'))
+    again = experiments.create(Experiment(name='mnist'))
+    assert first == again
+    assert [e.name for e in experiments.list()] == ['mnist']
+    experiments.remove('mnist')
+    assert experiments.get('mnist') is None
+
+
+def test_models_crud(store):
+    models = DocumentModels(store)
+    models.create(Model(hash='abc', experiment='mnist', epoch=0))
+    models.create(Model(hash='abc', experiment='mnist', epoch=0))  # no dup
+    assert len(models.list('mnist')) == 1
+    models.update(Model(hash='abc', experiment='mnist', epoch=5))
+    assert models.read('abc', 'mnist').epoch == 5
+    # same hash, different experiment = different row
+    models.update(Model(hash='abc', experiment='other', epoch=1))
+    assert models.read('abc', 'other').epoch == 1
+    models.delete('abc', 'mnist')
+    assert models.read('abc', 'mnist') is None
+
+
+def test_modules_put_dedupes_by_latest_hash(store):
+    modules = DocumentModules(store)
+    modules.put(Module(model='m', kind='nn', hash='h1', name='MLP', epoch=0))
+    modules.put(Module(model='m', kind='nn', hash='h1', name='MLP', epoch=3))
+    rows = modules.list('m')
+    assert len(rows) == 1 and rows[0].epoch == 3
+
+    # hash changed (hyperparameters edited) -> new row records the change
+    modules.put(Module(model='m', kind='nn', hash='h2', name='MLP', epoch=4))
+    assert len(modules.list('m')) == 2
+    # a different kind under the same model is independent
+    modules.put(Module(model='m', kind='optimizer', hash='h1', name='Adam', epoch=4))
+    assert len(modules.list('m')) == 3
+
+
+def test_iterations_put_upserts_per_phase(store):
+    iterations = DocumentIterations(store)
+    iterations.put(Iteration(model='m', phase='train', hash='l1', name='Loader', epoch=0))
+    iterations.put(Iteration(model='m', phase='train', hash='l1', name='Loader', epoch=2))
+    iterations.put(Iteration(model='m', phase='evaluation', hash='l1', name='Loader', epoch=2))
+    rows = iterations.list('m')
+    assert len(rows) == 2
+    train_rows = [r for r in rows if r.phase == 'train']
+    assert train_rows[0].epoch == 2
+    iterations.put(Iteration(model='m', phase='train', hash='l2', name='Loader', epoch=3))
+    assert len(iterations.list('m')) == 3
+
+
+def test_metrics_stream(store):
+    metrics = DocumentMetrics(store)
+    for epoch in range(3):
+        metrics.add(Metric(model='m', name='loss', value=1.0 / (epoch + 1),
+                           epoch=epoch, phase='train'))
+    metrics.add(Metric(model='other', name='loss', value=9.9, epoch=0, phase='train'))
+    series = metrics.list('m')
+    assert [point.epoch for point in series] == [0, 1, 2]
+    metrics.clear('m')
+    assert metrics.list('m') == [] and len(metrics.list('other')) == 1

@@ -1,0 +1,16 @@
+"""Checkpoint/resume subsystem.
+
+The reference persists weights with ``torch.save`` into a file named by the
+model's registry hash (``examples/tinysys/tinysys/repository.py:13-17``) and
+resumes by looking that id up again (``.../services/compilation.py:41-64``).
+The TPU-native equivalent keeps the same *flow* — identity hash names the
+checkpoint location, the build pipeline decides create/resume — but the
+mechanism is an async, sharded pytree checkpointer: every host writes only
+its own shards, saves overlap the next training step, and restore places
+each shard directly onto its mesh position.
+"""
+
+from tpusystem.checkpoint.checkpointer import Checkpointer, abstract_like
+from tpusystem.checkpoint.repository import Repository
+
+__all__ = ['Checkpointer', 'Repository', 'abstract_like']

@@ -1,0 +1,67 @@
+"""Weight repository keyed by aggregate identity.
+
+Reference-parity surface (``examples/tinysys/tinysys/repository.py``): the
+repository stores and restores an aggregate's learned state, addressed purely
+by ``aggregate.id`` — same hyperparameters, same identity, same checkpoint,
+across process restarts and host counts. Here the stored payload is the
+aggregate's device-state pytree (``aggregate.state``, a
+:class:`tpusystem.train.TrainState` or any pytree) rather than a pickled
+module, and saves are async + sharded via :class:`Checkpointer`.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Protocol, runtime_checkable
+
+from tpusystem.checkpoint.checkpointer import Checkpointer
+
+
+@runtime_checkable
+class Stateful(Protocol):
+    """Anything with an identity and a device-state pytree attribute."""
+    id: Any
+    state: Any
+
+
+class Repository:
+    """Store/restore aggregates by identity hash.
+
+    ``epoch`` defaults to the aggregate's own ``epoch`` attribute when it has
+    one (the reference saves every epoch via the ``Iterated`` event —
+    ``.../services/storage.py:84-86``), else to the next free version.
+    """
+
+    def __init__(self, root: str | pathlib.Path = 'data/weights', *,
+                 max_to_keep: int | None = 3, async_save: bool = True) -> None:
+        self.checkpointer = Checkpointer(root, max_to_keep=max_to_keep,
+                                         async_save=async_save)
+
+    def store(self, aggregate: Any, epoch: int | None = None) -> None:
+        """Persist ``aggregate.state`` under its identity."""
+        if epoch is None:
+            epoch = getattr(aggregate, 'epoch', None)
+        if epoch is None:
+            latest = self.checkpointer.latest(str(aggregate.id))
+            epoch = 0 if latest is None else latest + 1
+        self.checkpointer.save(str(aggregate.id), epoch, aggregate.state)
+
+    def restore(self, aggregate: Any, epoch: int | None = None) -> None:
+        """Load the stored pytree back into ``aggregate.state`` in place.
+
+        The current state's shapes/dtypes/shardings are the restore target,
+        so the weights land sharded for the *current* mesh even when saved on
+        a different topology.
+        """
+        aggregate.state = self.checkpointer.restore(
+            str(aggregate.id), aggregate.state, epoch)
+
+    def latest(self, aggregate: Any) -> int | None:
+        """Latest stored epoch for this aggregate, or ``None`` if fresh."""
+        return self.checkpointer.latest(str(aggregate.id))
+
+    def wait(self) -> None:
+        self.checkpointer.wait()
+
+    def close(self) -> None:
+        self.checkpointer.close()

@@ -20,19 +20,36 @@ from tpusystem.registry import register
 
 
 class SelfAttention(nn.Module):
+    """Causal multi-head self-attention with a pluggable kernel.
+
+    ``kernel='xla'`` (default) is einsum attention that GSPMD shards freely —
+    required under the DP/FSDP/TP policies, since a Pallas call cannot be
+    auto-partitioned. ``'flash'`` is the Pallas O(seq)-memory kernel for
+    single-chip runs; ``'ring'``/``'ulysses'`` are the sequence-parallel
+    variants (shard_map over the mesh's seq axis).
+
+    ``attn_dropout=None`` (default) applies ``dropout`` to the attention
+    probabilities on the 'xla' kernel — the torch-reference behavior — and
+    0.0 on kernels that don't implement it; set it explicitly to override.
+    """
+
     heads: int
     dropout: float
     dtype: jnp.dtype
-    kernel: str = 'flash'  # 'flash' (Pallas) | 'xla' | 'ring' | 'ulysses'
+    kernel: str = 'xla'    # 'xla' | 'flash' (Pallas) | 'ring' | 'ulysses'
     mesh: object = None    # required for 'ring'/'ulysses' (seq-sharded)
-    attn_dropout: float = 0.0  # attention-probability dropout; 'xla' only
+    attn_dropout: float | None = None  # None -> follow `dropout` ('xla' only)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
-        if self.attn_dropout and self.kernel != 'xla':
-            raise ValueError(
-                "attention-probability dropout is only implemented on the "
-                f"'xla' kernel, not {self.kernel!r}")
+        if self.attn_dropout is None:
+            attn_dropout = self.dropout if self.kernel == 'xla' else 0.0
+        else:
+            attn_dropout = self.attn_dropout
+            if attn_dropout and self.kernel != 'xla':
+                raise ValueError(
+                    "attention-probability dropout is only implemented on the "
+                    f"'xla' kernel, not {self.kernel!r}")
         dim = hidden.shape[-1]
         head_dim = dim // self.heads
         qkv = nn.Dense(3 * dim, dtype=self.dtype, name='qkv')(hidden)
@@ -44,14 +61,17 @@ class SelfAttention(nn.Module):
             context = flash_attention(query, key, value, causal=True)
         elif self.kernel in ('ring', 'ulysses'):
             from tpusystem.ops.ring import ring_self_attention
-            assert self.mesh is not None, 'ring/ulysses attention needs a mesh'
+            if self.mesh is None:
+                raise ValueError(
+                    f'{self.kernel!r} attention needs a mesh with a seq axis '
+                    '(pass mesh=... to the model)')
             context = ring_self_attention(query, key, value, self.mesh,
                                           causal=True, variant=self.kernel)
         elif self.kernel == 'xla':
             context = dot_product_attention(
                 query, key, value, causal=True,
-                dropout=self.attn_dropout if train else 0.0,
-                dropout_rng=self.make_rng('dropout') if train and self.attn_dropout else None)
+                dropout=attn_dropout if train else 0.0,
+                dropout_rng=self.make_rng('dropout') if train and attn_dropout else None)
         else:
             raise ValueError(f'unknown attention kernel {self.kernel!r}; '
                              "expected 'flash', 'xla', 'ring' or 'ulysses'")
@@ -64,9 +84,9 @@ class Block(nn.Module):
     mlp_ratio: int
     dropout: float
     dtype: jnp.dtype
-    attention: str = 'flash'
+    attention: str = 'xla'
     mesh: object = None
-    attn_dropout: float = 0.0
+    attn_dropout: float | None = None
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -102,9 +122,9 @@ class GPT2(nn.Module):
     mlp_ratio: int = 4
     dropout: float = 0.1
     dtype: str = 'bfloat16'
-    attention: str = 'flash'  # 'flash' | 'xla' | 'ring' | 'ulysses'
+    attention: str = 'xla'  # 'xla' (GSPMD-shardable) | 'flash' | 'ring' | 'ulysses'
     mesh: object = None  # mesh for ring/ulysses sequence parallelism
-    attn_dropout: float = 0.0  # attention-prob dropout (opt-in, 'xla' only)
+    attn_dropout: float | None = None  # None -> follow `dropout` ('xla' only)
     remat: bool = False  # recompute each block's activations in backward
 
     @nn.compact

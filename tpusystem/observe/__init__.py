@@ -1,0 +1,27 @@
+"""Observability: events, logging, TensorBoard, experiment tracking.
+
+The reference wires three decoupled consumers onto one producer — stdlib
+logging summaries, TinyDB metric persistence, TensorBoard scalars
+(``examples/tinysys/main.py:49-58``) — so the trainer never knows its
+observers. This package ships those consumers as framework components, plus
+the canonical training events they consume.
+
+Hot-path rule (SURVEY.md §7.3): every payload on the bus is already a
+materialized host value — consumers never touch device arrays, so one epoch
+has exactly one device→host sync per phase (``metrics.compute()``).
+"""
+
+from tpusystem.observe.events import Iterated, StepTimed, Trained, Validated
+from tpusystem.observe.logs import logging_consumer
+from tpusystem.observe.tensorboard import SummaryWriter, tensorboard_consumer
+from tpusystem.observe.tracking import (
+    experiment, metrics_store, models_store, modules_store, iterations_store,
+    repository, tracking_consumer,
+)
+
+__all__ = [
+    'Trained', 'Validated', 'Iterated', 'StepTimed',
+    'logging_consumer', 'SummaryWriter', 'tensorboard_consumer',
+    'tracking_consumer', 'experiment', 'metrics_store', 'models_store',
+    'modules_store', 'iterations_store', 'repository',
+]

@@ -1,0 +1,48 @@
+"""Canonical training events.
+
+The reference defines these in the application layer
+(``examples/tinysys/tinysys/services/training.py:50-63``); they are the
+ubiquitous language of every consumer, so the framework ships them. Payloads
+carry the *aggregate* (host-side object with ``id``/``epoch``/``phase``) and
+already-materialized metric floats — never device arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from tpusystem.services.prodcon import event
+
+
+@event
+class Trained:
+    """A training phase completed for the epoch."""
+    model: Any
+    metrics: dict[str, float]
+
+
+@event
+class Validated:
+    """An evaluation phase completed for the epoch."""
+    model: Any
+    metrics: dict[str, float]
+
+
+@event
+class Iterated:
+    """A full epoch (train + validate) completed."""
+    model: Any
+    loaders: Any = None
+
+
+@event
+class StepTimed:
+    """Wall-clock timing of a span of steps (profiling consumer food)."""
+    model: Any
+    phase: str
+    steps: int
+    seconds: float
+
+    @property
+    def steps_per_second(self) -> float:
+        return self.steps / self.seconds if self.seconds else 0.0

@@ -1,0 +1,163 @@
+"""TensorBoard scalar logging without the tensorboard package.
+
+The reference uses ``torch.utils.tensorboard.SummaryWriter``
+(``examples/tinysys/tinysys/services/tensorboard.py``); this environment
+ships no tensorboard, so the writer speaks the on-disk format directly —
+it is small and stable:
+
+* an event file is a **TFRecord** stream: for each record,
+  ``uint64 length | uint32 masked-crc32c(length) | payload |
+  uint32 masked-crc32c(payload)``;
+* each payload is a serialized ``tensorflow.Event`` protobuf: field 1
+  ``wall_time`` (double), field 2 ``step`` (int64), field 3
+  ``file_version`` (string, first record only), field 5 ``summary`` —
+  a ``Summary`` of repeated ``Summary.Value`` {tag: field 1, simple_value:
+  field 2}.
+
+Both are hand-encoded here (varint/fixed encoders + a table-driven CRC32C),
+so any TensorBoard install can read the runs this framework writes.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import socket
+import struct
+import time
+
+from tpusystem.observe.events import Trained, Validated
+from tpusystem.services.prodcon import Consumer, Depends
+
+# ---------------------------------------------------------------- crc32c ---
+
+_CRC_TABLE = []
+for _index in range(256):
+    _crc = _index
+    for _ in range(8):
+        _crc = (_crc >> 1) ^ (0x82F63B78 if _crc & 1 else 0)  # Castagnoli poly
+    _CRC_TABLE.append(_crc)
+
+
+def _crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for byte in data:
+        crc = (crc >> 8) ^ _CRC_TABLE[(crc ^ byte) & 0xFF]
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------------- protobuf ---
+
+def _varint(value: int) -> bytes:
+    out = bytearray()
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(bits | 0x80)
+        else:
+            out.append(bits)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint(field << 3 | wire)
+
+
+def _double_field(field: int, value: float) -> bytes:
+    return _tag(field, 1) + struct.pack('<d', value)
+
+
+def _float_field(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack('<f', value)
+
+
+def _int_field(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(value & 0xFFFFFFFFFFFFFFFF)
+
+
+def _bytes_field(field: int, value: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(value)) + value
+
+
+def _scalar_event(tag: str, value: float, step: int, wall_time: float) -> bytes:
+    summary_value = (_bytes_field(1, tag.encode()) + _float_field(2, value))
+    summary = _bytes_field(1, summary_value)
+    return (_double_field(1, wall_time) + _int_field(2, step)
+            + _bytes_field(5, summary))
+
+
+def _version_event(wall_time: float) -> bytes:
+    return _double_field(1, wall_time) + _bytes_field(3, b'brain.Event:2')
+
+
+# --------------------------------------------------------------- writer ---
+
+class SummaryWriter:
+    """Append-only TensorBoard event-file writer for scalar curves."""
+
+    def __init__(self, logdir: str | os.PathLike) -> None:
+        self.logdir = pathlib.Path(logdir)
+        self.logdir.mkdir(parents=True, exist_ok=True)
+        stamp = time.time()
+        name = f'events.out.tfevents.{stamp:.0f}.{socket.gethostname()}.{os.getpid()}'
+        self._handle = open(self.logdir / name, 'ab')
+        self._record(_version_event(stamp))
+        self.flush()
+
+    def _record(self, payload: bytes) -> None:
+        header = struct.pack('<Q', len(payload))
+        self._handle.write(header)
+        self._handle.write(struct.pack('<I', _masked_crc(header)))
+        self._handle.write(payload)
+        self._handle.write(struct.pack('<I', _masked_crc(payload)))
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        self._record(_scalar_event(tag, float(value), int(step), time.time()))
+
+    def add_scalars(self, main_tag: str, values: dict[str, float], step: int) -> None:
+        """Scalars under ``{main_tag}/{name}`` (flat-file variant of the
+        torch API the reference calls — ``tensorboard.py:17-19``)."""
+        for name, value in values.items():
+            self.add_scalar(f'{main_tag}/{name}', value, step)
+
+    def flush(self) -> None:
+        self._handle.flush()
+
+    def close(self) -> None:
+        self._handle.flush()
+        self._handle.close()
+
+
+# ------------------------------------------------------------- consumer ---
+
+def writer() -> SummaryWriter:
+    """DI seam for the summary writer — override at the composition root::
+
+        def tensorboard():            # generator dep: flushes on teardown
+            writer = SummaryWriter('data/runs')
+            yield writer
+            writer.close()
+        consumer.dependency_overrides[writer] = tensorboard
+    """
+    raise NotImplementedError('override the tensorboard writer dependency')
+
+
+def tensorboard_consumer() -> Consumer:
+    """Consumer charting ``{model.id}/{metric}`` per phase at each epoch."""
+    consumer = Consumer('tensorboard')
+
+    @consumer.handler
+    def on_metrics(event: Trained | Validated,
+                   board: SummaryWriter = Depends(writer)) -> None:
+        phase = 'train' if isinstance(event, Trained) else 'evaluation'
+        for name, value in event.metrics.items():
+            board.add_scalar(f'{event.model.id}/{name}/{phase}', value,
+                             getattr(event.model, 'epoch', 0))
+
+    return consumer

@@ -15,9 +15,11 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def causal_mask(query_length: int, key_length: int, *, offset: int = 0) -> jax.Array:
-    """Boolean [q, k] mask where True = attend. ``offset`` is the absolute
-    position of the first query (used by ring attention blocks)."""
+def causal_mask(query_length: int, key_length: int,
+                *, offset: int | jax.Array = 0) -> jax.Array:
+    """Boolean [q, k] mask where True = attend. ``offset`` is the position of
+    the first query relative to the first key (used by ring attention blocks;
+    may be a traced value such as ``rank * chunk``)."""
     query_positions = jnp.arange(query_length)[:, None] + offset
     key_positions = jnp.arange(key_length)[None, :]
     return query_positions >= key_positions

@@ -11,11 +11,18 @@ Kernel shape notes (see /opt/skills/guides/pallas_guide.md):
   innermost — running max/sum/accumulator live in VMEM scratch across the
   kv sweep and the output block is written once on the final kv step;
 * softmax statistics are kept as (block_q, 128) f32 tiles (lane-replicated)
-  to match the VPU tile shape;
+  to match the VPU tile shape *inside* the kernel, but logsumexp is stored
+  to HBM as a compact (bh, seq, 8) array (sublane-tile replication only);
 * causal blocks strictly above the diagonal are skipped via predication;
   the diagonal block applies a triangular mask from 2D broadcasted_iota;
 * logsumexp is saved for the backward pass, which recomputes P blockwise
   (dq kernel sweeps kv; dk/dv kernel sweeps q innermost).
+
+SPMD note: a ``pallas_call`` is a manual computation that GSPMD cannot
+auto-partition, so this kernel is for **single-device-per-shard** contexts:
+one chip, or inside ``shard_map`` (as the ring/Ulysses wrappers do). Under
+GSPMD policies (DP/FSDP/TP) use the ``'xla'`` attention kernel, which the
+partitioner shards freely.
 
 ``interpret=True`` runs the same kernels in interpreter mode for CPU tests.
 """
@@ -23,15 +30,42 @@ Kernel shape notes (see /opt/skills/guides/pallas_guide.md):
 from __future__ import annotations
 
 import functools
-import math
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-NEG_INF = -1e30
-LANES = 128
+from tpusystem.ops.attention import NEG_INF
+
+LANES = 128  # VPU lane count: in-VMEM softmax stats are (block_q, LANES) tiles
+STATS = 8    # trailing dim of HBM-stored lse/delta — the f32 sublane tile.
+             # Mosaic requires the last two block dims divisible by (8, 128) or
+             # equal to the array dims, so a compact (bh, seq) layout is not
+             # lowerable; (bh, seq, 8) stores 8 replicated f32 per position,
+             # 16x less HBM than lane-replicated (bh, seq, 128).
+
+
+def _masked_scores(query, key, *, scale, causal, q_idx, kv_idx,
+                   block_q, block_kv):
+    """f32 (block_q, block_kv) scores with the causal mask applied.
+
+    Shared by the forward, dq and dkv kernels so the mask/scale arithmetic
+    cannot drift between forward and backward.
+    """
+    scores = jax.lax.dot_general(
+        query, key, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) + q_idx * block_q
+        cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + kv_idx * block_kv
+        scores = jnp.where(rows >= cols, scores, NEG_INF)
+    return scores
+
+
+def _visible(causal: bool, q_idx, kv_idx, block_q: int, block_kv: int):
+    """Predicate: does this (q, kv) block intersect the causal triangle?"""
+    return (not causal) or (q_idx * block_q + block_q - 1 >= kv_idx * block_kv)
 
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
@@ -48,20 +82,13 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     # causal: skip blocks strictly above the diagonal
-    compute = (not causal) or (q_idx * block_q + block_q - 1 >= kv_idx * block_kv)
-
-    @pl.when(compute if isinstance(compute, bool) else compute)
+    @pl.when(_visible(causal, q_idx, kv_idx, block_q, block_kv))
     def _block():
         query = q_ref[0]                      # (block_q, head_dim)
-        key = k_ref[0]                        # (block_kv, head_dim)
         value = v_ref[0]
-        scores = jax.lax.dot_general(
-            query, key, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # (block_q, block_kv)
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) + q_idx * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + kv_idx * block_kv
-            scores = jnp.where(rows >= cols, scores, NEG_INF)
+        scores = _masked_scores(query, k_ref[0], scale=scale, causal=causal,
+                                q_idx=q_idx, kv_idx=kv_idx,
+                                block_q=block_q, block_kv=block_kv)
 
         m_prev = m_scr[:, :1]                               # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
@@ -79,8 +106,8 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_final = l_scr[:, :1]
         safe_l = jnp.where(l_final == 0.0, 1.0, l_final)
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
-        lse = m_scr[:, :1] + jnp.log(safe_l)
-        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+        lse = m_scr[:, :1] + jnp.log(safe_l)                # (block_q, 1)
+        lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], STATS))
 
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -93,24 +120,18 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    compute = (not causal) or (q_idx * block_q + block_q - 1 >= kv_idx * block_kv)
-
-    @pl.when(compute if isinstance(compute, bool) else compute)
+    @pl.when(_visible(causal, q_idx, kv_idx, block_q, block_kv))
     def _block():
-        query, key, value = q_ref[0], k_ref[0], v_ref[0]
+        key, value = k_ref[0], v_ref[0]
         grad_out = do_ref[0]
-        scores = jax.lax.dot_general(
-            query, key, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) + q_idx * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + kv_idx * block_kv
-            scores = jnp.where(rows >= cols, scores, NEG_INF)
-        probs = jnp.exp(scores - lse_ref[0][:, :1])
+        scores = _masked_scores(q_ref[0], key, scale=scale, causal=causal,
+                                q_idx=q_idx, kv_idx=kv_idx,
+                                block_q=block_q, block_kv=block_kv)
+        probs = jnp.exp(scores - lse_ref[0, :, :1])          # (block_q, 1)
         dprobs = jax.lax.dot_general(
             grad_out, value, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dscores = probs * (dprobs - delta_ref[0][:, :1]) * scale
+        dscores = probs * (dprobs - delta_ref[0, :, :1]) * scale
         dq_scr[...] += jax.lax.dot_general(
             dscores.astype(key.dtype), key, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -132,27 +153,21 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    compute = (not causal) or (q_idx * block_q + block_q - 1 >= kv_idx * block_kv)
-
-    @pl.when(compute if isinstance(compute, bool) else compute)
+    @pl.when(_visible(causal, q_idx, kv_idx, block_q, block_kv))
     def _block():
-        query, key, value = q_ref[0], k_ref[0], v_ref[0]
+        query, value = q_ref[0], v_ref[0]
         grad_out = do_ref[0]
-        scores = jax.lax.dot_general(
-            query, key, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) + q_idx * block_q
-            cols = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) + kv_idx * block_kv
-            scores = jnp.where(rows >= cols, scores, NEG_INF)
-        probs = jnp.exp(scores - lse_ref[0][:, :1])           # (bq, bkv)
+        scores = _masked_scores(query, k_ref[0], scale=scale, causal=causal,
+                                q_idx=q_idx, kv_idx=kv_idx,
+                                block_q=block_q, block_kv=block_kv)
+        probs = jnp.exp(scores - lse_ref[0, :, :1])           # (bq, bkv)
         dv_scr[...] += jax.lax.dot_general(
             probs.astype(grad_out.dtype), grad_out, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bkv, d)
         dprobs = jax.lax.dot_general(
             grad_out, value, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dscores = probs * (dprobs - delta_ref[0][:, :1]) * scale
+        dscores = probs * (dprobs - delta_ref[0, :, :1]) * scale
         dk_scr[...] += jax.lax.dot_general(
             dscores.astype(query.dtype), query, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -195,11 +210,11 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((1, block_q, STATS), lambda i, j, k_: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_q, STATS), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),
@@ -216,8 +231,8 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, residuals, grad_out)
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
     delta = jnp.sum(grad_out.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)                    # (bh, sq, 1)
-    delta = jnp.broadcast_to(delta, (bh, seq_q, LANES))
+                    axis=-1, keepdims=True)                   # (bh, seq_q, 1)
+    delta = jnp.broadcast_to(delta, (bh, seq_q, STATS))
 
     dq_kernel = functools.partial(
         _flash_dq_kernel, scale=scale, causal=causal,
@@ -230,8 +245,8 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, residuals, grad_out)
             pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
             pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
             pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda i, j, k_: (i, j, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((1, block_q, STATS), lambda i, j, k_: (i, j, 0)),
+            pl.BlockSpec((1, block_q, STATS), lambda i, j, k_: (i, j, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -250,8 +265,8 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, residuals, grad_out)
             pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
             pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
             pl.BlockSpec((1, block_q, head_dim), lambda i, k_, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda i, k_, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda i, k_, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, STATS), lambda i, k_, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, STATS), lambda i, k_, j: (i, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
@@ -280,8 +295,9 @@ def flash_attention(query, key, value, *, causal: bool = True,
     """Flash attention over [batch, length, heads, head_dim] tensors.
 
     Drop-in for :func:`tpusystem.ops.attention.dot_product_attention`
-    (GQA supported via KV-head broadcast). Falls back to the XLA path when
-    the sequence length does not divide the block sizes.
+    (GQA supported via KV-head broadcast) in single-device-per-shard
+    contexts — see the module docstring for the GSPMD caveat. Falls back to
+    the XLA path when the sequence length does not divide the block sizes.
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same
     model code runs in CPU tests.
     """

@@ -26,11 +26,10 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
+from tpusystem.ops.attention import NEG_INF, causal_mask
 from tpusystem.parallel.mesh import DATA, FSDP, SEQ
-
-NEG_INF = -1e30
 
 
 def _chunk_scores(query, key, scale, q_offset, kv_offset, causal):
@@ -38,9 +37,9 @@ def _chunk_scores(query, key, scale, q_offset, kv_offset, causal):
     scores = jnp.einsum('bqhd,bkhd->bhqk', query, key,
                         preferred_element_type=jnp.float32) * scale
     if causal:
-        q_positions = jnp.arange(query.shape[1])[:, None] + q_offset
-        k_positions = jnp.arange(key.shape[1])[None, :] + kv_offset
-        scores = jnp.where(q_positions >= k_positions, scores, NEG_INF)
+        mask = causal_mask(query.shape[1], key.shape[1],
+                           offset=q_offset - kv_offset)
+        scores = jnp.where(mask, scores, NEG_INF)
     return scores
 
 

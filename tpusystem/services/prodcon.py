@@ -90,11 +90,19 @@ class Consumer:
                 f'consumer handler {wrapped.__name__!r} needs a first parameter '
                 'annotated with the event type(s) it consumes')
         first = next(iter(parameters.values()))
-        if first.annotation is first.empty:
+        annotation = first.annotation
+        if annotation is first.empty:
             raise TypeError(
                 f'consumer handler {wrapped.__name__!r} first parameter must be '
                 'annotated with the event type(s) it consumes')
-        return self.register(first.annotation, wrapped)
+        if isinstance(annotation, str):
+            # PEP 563 (`from __future__ import annotations`) stringizes
+            # annotations; resolve only the routing parameter so unrelated
+            # unresolvable annotations (TYPE_CHECKING-only imports, locals)
+            # don't break registration.
+            function = getattr(wrapped, '__func__', wrapped)
+            annotation = eval(annotation, getattr(function, '__globals__', {}))  # noqa: S307
+        return self.register(annotation, wrapped)
 
     def consume(self, message: Any) -> None:
         """Invoke all handlers for the message's type; unknown types are ignored."""
