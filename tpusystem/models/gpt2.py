@@ -11,6 +11,7 @@ per-experiment configuration.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
@@ -169,6 +170,101 @@ class GPT2(nn.Module):
 
 
 register(GPT2, excluded_kwargs={'mesh'})
+
+
+class GPT2Pipelined:
+    """GPT-2 with its block stack pipelined over the ``stage`` mesh axis.
+
+    Blocks are initialized *stacked* (leading ``layers`` dimension via
+    ``jax.vmap`` of ``Block.init``) and executed through
+    :func:`tpusystem.parallel.pipeline.pipeline_apply`: each stage owns
+    ``layers/stages`` layers, microbatch activations ride the ICI ring.
+    Embeddings, final layernorm, and the tied LM head run replicated over
+    ``stage`` (they are a tiny fraction of the FLOPs).
+
+    Implements the same ``init``/``apply``/``__call__`` surface the step
+    builders expect from a flax module, so ``init_state``/``flax_apply``
+    work unchanged. Dropout is 0 inside the pipe (pretraining-scale
+    convention); the reference never pipelines at all (SURVEY.md §2.4).
+    """
+
+    def __init__(self, vocab_size: int = 50257, layers: int = 12,
+                 dim: int = 768, heads: int = 12, max_seq: int = 1024,
+                 mlp_ratio: int = 4, dtype: str = 'bfloat16',
+                 microbatches: int = 4, remat: bool = True, mesh=None):
+        if mesh is None:
+            raise ValueError('GPT2Pipelined needs a mesh with a stage axis')
+        self.vocab_size, self.layers, self.dim = vocab_size, layers, dim
+        self.heads, self.max_seq, self.mlp_ratio = heads, max_seq, mlp_ratio
+        self.dtype = dtype
+        self.microbatches, self.remat, self.mesh = microbatches, remat, mesh
+        self.block = Block(heads, mlp_ratio, 0.0, jnp.dtype(dtype))
+
+    def __call__(self, tokens, train: bool = False):
+        raise TypeError('bind parameters via .apply(), like a flax module')
+
+    def init(self, rng, tokens, train: bool = False):
+        keys = jax.random.split(rng, self.layers + 2)
+        sample = jnp.zeros((1, 8, self.dim), jnp.dtype(self.dtype))
+        stacked = jax.vmap(lambda key: self.block.init(key, sample)['params'])(
+            keys[:self.layers])
+        scale = 0.02
+        wte = scale * jax.random.normal(keys[-2], (self.vocab_size, self.dim))
+        wpe = scale * jax.random.normal(keys[-1], (self.max_seq, self.dim))
+        return {'params': {
+            'wte': {'embedding': wte}, 'wpe': {'embedding': wpe},
+            'h': stacked,
+            'ln_f': {'scale': jnp.ones(self.dim), 'bias': jnp.zeros(self.dim)},
+        }}
+
+    def _embed(self, params, tokens):
+        length = tokens.shape[-1]
+        assert length <= self.max_seq, (length, self.max_seq)
+        embedding = params['wte']['embedding']
+        hidden = embedding[tokens] + params['wpe']['embedding'][:length]
+        return hidden.astype(jnp.dtype(self.dtype))
+
+    def _head(self, params, hidden):
+        hidden = hidden.astype(jnp.float32)
+        mean = hidden.mean(-1, keepdims=True)
+        variance = ((hidden - mean) ** 2).mean(-1, keepdims=True)
+        hidden = (hidden - mean) * jax.lax.rsqrt(variance + 1e-6)
+        hidden = hidden * params['ln_f']['scale'] + params['ln_f']['bias']
+        return hidden @ params['wte']['embedding'].T
+
+    def _block_fn(self):
+        def block_fn(layer_params, activations):
+            return self.block.apply({'params': layer_params}, activations)
+        return block_fn
+
+    def apply(self, variables, tokens, rngs=None, train: bool = False):
+        from tpusystem.parallel.pipeline import pipeline_apply
+        params = variables['params']
+        hidden = self._embed(params, tokens)
+        hidden = pipeline_apply(self._block_fn(), params['h'], hidden, self.mesh,
+                                microbatches=self.microbatches, remat=self.remat)
+        return self._head(params, hidden)
+
+    def sequential_apply(self, variables, tokens):
+        """Reference forward without the pipeline (correctness harness)."""
+        params = variables['params']
+        hidden = self._embed(params, tokens)
+        block_fn = self._block_fn()
+
+        def layer(carry, layer_params):
+            return block_fn(layer_params, carry), None
+
+        hidden, _ = jax.lax.scan(layer, hidden, params['h'])
+        return self._head(params, hidden)
+
+    @staticmethod
+    def partition_rules():
+        """Stage sharding for the stacked blocks; embeddings/ln replicated
+        (combine with ``fsdp=True`` on the policy to scatter them)."""
+        return ((r'(^|/)h/', P('stage')),)
+
+
+register(GPT2Pipelined, excluded_kwargs={'mesh'})
 
 
 def gpt2_small(**overrides) -> GPT2:

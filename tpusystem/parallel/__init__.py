@@ -2,10 +2,12 @@ from tpusystem.parallel.mesh import (
     AXES, DATA, EXPERT, FSDP, MODEL, SEQ, STAGE,
     MeshSpec, batch_sharding, replicated, single_device_mesh,
 )
+from tpusystem.parallel.pipeline import PipelineParallel, pipeline_apply
 from tpusystem.parallel.sharding import (
     DataParallel, FullyShardedDataParallel, ShardingPolicy, TensorParallel,
 )
 
 __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'ShardingPolicy', 'DataParallel', 'FullyShardedDataParallel',
-           'TensorParallel', 'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE']
+           'TensorParallel', 'PipelineParallel', 'pipeline_apply',
+           'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE']

@@ -1,0 +1,124 @@
+"""Pipeline parallelism — microbatch schedule over the ``stage`` mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2.4: "PP | absent");
+this module supplies it TPU-natively: layers are stacked into a leading
+``layers`` dimension, that dimension is sharded over the ``stage`` axis (so
+each device owns ``layers / stages`` contiguous layers), and microbatch
+activations travel stage-to-stage with ``ppermute`` over the ICI ring inside
+``shard_map``.
+
+Schedule: GPipe. All microbatch forwards stream through the pipe; XLA's
+autodiff of the tick ``lax.scan`` then replays the schedule in reverse, so
+the backward pass drains the pipe stage-by-stage in the transposed order —
+the same bubble fraction as hand-written 1F1B, ``(S-1)/(M+S-1)``, with
+memory bounded by per-microbatch rematerialisation (``remat=True`` wraps
+each stage body in ``jax.checkpoint``, so live activations are O(M) *block
+inputs*, not O(M·L) intermediates).
+
+Composition: the batch dimension stays sharded over ``(data, fsdp)``, so
+DP×PP works out of the box. Tensor parallelism *within* a stage is left to
+GSPMD outside the shard_map (a stage body is local by construction).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpusystem.parallel.mesh import DATA, FSDP, STAGE
+from tpusystem.parallel.sharding import ShardingPolicy
+
+# One layer of the pipelined stack: (layer_params, activations) -> activations
+BlockFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
+                   mesh, *, microbatches: int, remat: bool = True) -> jax.Array:
+    """Run ``hidden`` through a layer stack pipelined over ``stage``.
+
+    Args:
+        block_fn: pure per-layer function ``(layer_params, x) -> x``.
+        stacked_params: pytree whose leaves carry a leading ``layers``
+            dimension (e.g. built with ``jax.vmap(block.init)``); ``layers``
+            must be divisible by the mesh's ``stage`` size.
+        hidden: global activations ``[batch, ...]``; batch must divide by
+            ``data*fsdp*microbatches``.
+        mesh: mesh with a ``stage`` axis (size 1 degenerates gracefully).
+        microbatches: how many microbatches to stream through the pipe.
+    """
+    stages = mesh.shape[STAGE]
+    layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if layers % stages:
+        raise ValueError(f'{layers} layers not divisible by {stages} stages')
+    data_parallel = mesh.shape[DATA] * mesh.shape[FSDP]
+    if hidden.shape[0] % (data_parallel * microbatches):
+        raise ValueError(
+            f'batch {hidden.shape[0]} not divisible by data*fsdp*microbatches '
+            f'= {data_parallel}*{microbatches}')
+    batch_axes = (DATA, FSDP) if data_parallel > 1 else None
+    activation_spec = P(batch_axes, *([None] * (hidden.ndim - 1)))
+    param_specs = jax.tree.map(lambda _: P(STAGE), stacked_params)
+
+    stage_body = _stage_scan(block_fn)
+    if remat:
+        stage_body = jax.checkpoint(stage_body)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(param_specs, activation_spec),
+        out_specs=activation_spec, check_vma=False)
+    def pipelined(params, local_hidden):
+        stage = lax.axis_index(STAGE)
+        count = lax.axis_size(STAGE)
+        shape = (microbatches, local_hidden.shape[0] // microbatches)
+        batches = local_hidden.reshape(shape + local_hidden.shape[1:])
+
+        def tick(state, t):
+            feed = lax.dynamic_index_in_dim(
+                batches, jnp.clip(t, 0, microbatches - 1), keepdims=False)
+            take = jnp.logical_and(stage == 0, t < microbatches)
+            state = jnp.where(take, feed, state)
+            state = stage_body(params, state)
+            emitted = state
+            if count > 1:
+                permutation = [(source, (source + 1) % count)
+                               for source in range(count)]
+                state = lax.ppermute(state, STAGE, permutation)
+            return state, emitted
+
+        ticks = microbatches + count - 1
+        state = jnp.zeros_like(batches[0])
+        _, emitted = lax.scan(tick, state, jnp.arange(ticks))
+        # the last stage emits microbatch m at tick m + count - 1; everyone
+        # else contributes zeros and the psum broadcasts the result
+        outputs = lax.slice_in_dim(emitted, count - 1, count - 1 + microbatches)
+        outputs = jnp.where(stage == count - 1, outputs, 0)
+        if count > 1:
+            outputs = lax.psum(outputs, STAGE)
+        return outputs.reshape(local_hidden.shape)
+
+    return pipelined(stacked_params, hidden)
+
+
+def _stage_scan(block_fn: BlockFn):
+    """Apply this stage's local layer stack (leading dim layers/stages)."""
+    def run(params, state):
+        def layer(carry, layer_params):
+            return block_fn(layer_params, carry), None
+        state, _ = lax.scan(layer, state, params)
+        return state
+    return run
+
+
+def PipelineParallel(stacked_prefix: str = r'(^|/)h/', extra_rules=(),
+                     fsdp: bool = False, fsdp_min_size: int = 4096) -> ShardingPolicy:
+    """Sharding policy for pipelined models: leaves under ``stacked_prefix``
+    (the stacked layer collection) shard their leading ``layers`` dimension
+    over ``stage``; everything else follows ``extra_rules`` / FSDP."""
+    rules = ((stacked_prefix, P(STAGE)),) + tuple(extra_rules)
+    return ShardingPolicy(rules=rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size)

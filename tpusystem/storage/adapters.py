@@ -72,11 +72,12 @@ class DocumentModules(ports.Modules):
     def put(self, module: Module) -> None:
         rows = self.table.search(where(model=module.model, kind=module.kind))
         if rows and rows[-1]['hash'] == module.hash:
-            self.table.update(
+            # bump only the *latest* row: earlier rows with the same hash are
+            # history (hyperparameters changed away and back) and must keep
+            # the epochs at which they were recorded
+            self.table.update_last(
                 {'epoch': module.epoch},
-                lambda doc: (doc.get('model') == module.model
-                             and doc.get('kind') == module.kind
-                             and doc.get('hash') == module.hash))
+                where(model=module.model, kind=module.kind, hash=module.hash))
         else:
             self.table.insert(unstructure(module))
 
@@ -107,11 +108,10 @@ class DocumentIterations(ports.Iterations):
     def put(self, iteration: Iteration) -> None:
         rows = self.table.search(where(model=iteration.model, phase=iteration.phase))
         if rows and rows[-1]['hash'] == iteration.hash:
-            self.table.update(
+            self.table.update_last(
                 {'epoch': iteration.epoch},
-                lambda doc: (doc.get('model') == iteration.model
-                             and doc.get('phase') == iteration.phase
-                             and doc.get('hash') == iteration.hash))
+                where(model=iteration.model, phase=iteration.phase,
+                      hash=iteration.hash))
         else:
             self.table.insert(unstructure(iteration))
 

@@ -70,6 +70,19 @@ class Table:
         with self._store._lock:
             return [dict(doc) for doc in self._data().values()]
 
+    def update_last(self, changes: Document, predicate: Predicate) -> int:
+        """Apply field changes to the *latest* matching document only (the
+        one with the highest id); returns 1 if a document matched, else 0."""
+        with self._store._lock:
+            matched = [key for key, doc in self._data().items()
+                       if predicate(doc)]
+            if not matched:
+                return 0
+            last = max(matched, key=int)
+            self._data()[last].update(changes)
+            self._store._flush()
+            return 1
+
     def update(self, changes: Document, predicate: Predicate) -> int:
         """Apply field changes to matching documents; returns match count."""
         with self._store._lock:
