@@ -1,0 +1,97 @@
+"""Mixture-of-experts and expert parallelism over the ``expert`` mesh axis.
+
+The reference has only a dense MLP (SURVEY.md §2.4: "EP/MoE | absent").
+Coverage: routing algebra (capacity, drops, gate renormalization), the MoE
+layer's dense-equivalence limit, and an expert-parallel GPT-2 train step on
+a simulated (data x expert) mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusystem.models import GPT2
+from tpusystem.ops import MoEMLP, expert_capacity, route_top_k
+from tpusystem.parallel import MeshSpec, ShardingPolicy, batch_sharding
+from tpusystem.train import (AdamW, NextTokenLoss, WithAuxLoss,
+                             build_train_step, flax_apply, init_state)
+
+
+def test_route_top_k_seats_every_token_with_ample_capacity():
+    gates = jax.nn.softmax(jax.random.normal(jax.random.PRNGKey(0), (16, 4)))
+    dispatch, combine, fraction = route_top_k(gates, k=2, capacity=16)
+    # every token seated for both choices
+    np.testing.assert_allclose(np.asarray(dispatch.sum((1, 2))), 2.0)
+    # combine weights renormalize the chosen gates to sum to 1 per token
+    np.testing.assert_allclose(np.asarray(combine.sum((1, 2))), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(fraction.sum()), 1.0, rtol=1e-6)
+
+
+def test_route_top_k_respects_capacity_and_drops_overflow():
+    # all 8 tokens want expert 0 first; capacity 2 seats only the first 2
+    gates = jnp.tile(jnp.asarray([[0.7, 0.3, 0.0, 0.0]]), (8, 1))
+    dispatch, combine, _ = route_top_k(gates, k=1, capacity=2)
+    per_expert = np.asarray(dispatch.sum((0, 2)))
+    assert per_expert[0] == 2.0, per_expert
+    assert per_expert[1:].sum() == 0.0, per_expert
+    seated_tokens = np.asarray(dispatch.sum((1, 2)))
+    np.testing.assert_array_equal(seated_tokens[:2], 1.0)
+    np.testing.assert_array_equal(seated_tokens[2:], 0.0)
+
+
+def test_first_choices_seat_before_second_choices():
+    # token 0's first choice and token 1's second choice collide on expert 0
+    # with capacity 1: the first choice must win regardless of token order
+    gates = jnp.asarray([[0.9, 0.1, 0.0, 0.0]] * 1 + [[0.1, 0.9, 0.0, 0.0]] * 1)
+    gates = jnp.concatenate([gates[1:], gates[:1]])  # token 0 prefers e1, token 1 prefers e0
+    dispatch, _, _ = route_top_k(gates, k=2, capacity=1)
+    # expert 0: token 1 (first choice) seated; token 0's second choice dropped
+    expert0 = np.asarray(dispatch[:, 0].sum(-1))
+    np.testing.assert_array_equal(expert0, [0.0, 1.0])
+
+
+def test_moe_single_expert_matches_dense_ffn():
+    """experts=1, k=1, ample capacity reduces to a plain FFN."""
+    layer = MoEMLP(experts=1, k=1, capacity_factor=4.0, dtype=jnp.float32)
+    hidden = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 16))
+    variables = layer.init(jax.random.PRNGKey(1), hidden)
+    output, aux = layer.apply(variables, hidden)
+    params = variables['params']
+    dense = jax.nn.gelu(hidden.reshape(-1, 16) @ params['w1'][0] + params['b1'][0])
+    dense = dense @ params['w2'][0] + params['b2'][0]
+    np.testing.assert_allclose(np.asarray(output.reshape(-1, 16)),
+                               np.asarray(dense), rtol=1e-4, atol=1e-5)
+    assert float(aux) > 0.0
+
+
+def test_expert_capacity_bounds():
+    assert expert_capacity(128, 8, 2, 1.0) == 32
+    assert expert_capacity(4, 8, 1, 1.0) == 1       # floor of 1
+    assert expert_capacity(8, 2, 2, 100.0) == 8     # ceiling of all tokens
+
+
+def test_moe_gpt2_expert_parallel_train_step():
+    mesh = MeshSpec(data=2, expert=4).build()
+    model = GPT2(vocab_size=64, layers=2, dim=32, heads=4, max_seq=32,
+                 dropout=0.0, dtype='float32', moe_experts=4, moe_every=2,
+                 moe_k=2, mesh=mesh)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 64, (8, 16)))
+    optimizer = AdamW(lr=1e-2)
+    state = init_state(model, optimizer, tokens[:2])
+    policy = ShardingPolicy(rules=GPT2.partition_rules())
+    state = policy.place(state, mesh)
+    # stacked expert weights actually sharded over the expert axis
+    spec = state.params['h_1']['moe']['w1'].sharding.spec
+    assert spec[0] == 'expert', spec
+    tokens = jax.device_put(tokens, batch_sharding(mesh))
+
+    step = build_train_step(flax_apply(model), WithAuxLoss(NextTokenLoss()),
+                            optimizer)
+    losses = []
+    for _ in range(4):
+        state, (outputs, loss) = step(state, tokens, tokens)
+        losses.append(float(loss))
+    logits, aux = outputs
+    assert logits.shape == (8, 16, 64)
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses

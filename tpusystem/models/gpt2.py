@@ -81,6 +81,10 @@ class SelfAttention(nn.Module):
 
 
 class Block(nn.Module):
+    """Transformer block. With ``moe_experts > 0`` the FFN is an
+    expert-parallel :class:`~tpusystem.ops.moe.MoEMLP` and the block
+    returns ``(hidden, aux_loss)`` instead of ``hidden``."""
+
     heads: int
     mlp_ratio: int
     dropout: float
@@ -88,6 +92,9 @@ class Block(nn.Module):
     attention: str = 'xla'
     mesh: object = None
     attn_dropout: float | None = None
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -101,12 +108,22 @@ class Block(nn.Module):
         attended = nn.Dropout(self.dropout, deterministic=not train)(attended)
         hidden = hidden + attended
         normed = nn.LayerNorm(dtype=jnp.float32, name='ln_2')(hidden)
-        grown = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name='fc')(
-            normed.astype(self.dtype))
-        grown = nn.gelu(grown)
-        shrunk = nn.Dense(dim, dtype=self.dtype, name='proj')(grown)
+        if self.moe_experts:
+            from tpusystem.ops.moe import MoEMLP
+            shrunk, aux = MoEMLP(self.moe_experts, k=self.moe_k,
+                                 mlp_ratio=self.mlp_ratio,
+                                 capacity_factor=self.moe_capacity_factor,
+                                 dtype=self.dtype, mesh=self.mesh,
+                                 name='moe')(normed.astype(self.dtype))
+        else:
+            grown = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name='fc')(
+                normed.astype(self.dtype))
+            grown = nn.gelu(grown)
+            shrunk = nn.Dense(dim, dtype=self.dtype, name='proj')(grown)
+            aux = None
         shrunk = nn.Dropout(self.dropout, deterministic=not train)(shrunk)
-        return hidden + shrunk
+        hidden = hidden + shrunk
+        return (hidden, aux) if self.moe_experts else hidden
 
 
 class GPT2(nn.Module):
@@ -127,6 +144,10 @@ class GPT2(nn.Module):
     mesh: object = None  # mesh for ring/ulysses sequence parallelism
     attn_dropout: float | None = None  # None -> follow `dropout` ('xla' only)
     remat: bool = False  # recompute each block's activations in backward
+    moe_experts: int = 0  # >0: MoE FFN in every `moe_every`-th block
+    moe_every: int = 2
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -142,15 +163,34 @@ class GPT2(nn.Module):
         assert tokens.shape[-1] <= self.max_seq, (
             f'sequence length {tokens.shape[-1]} exceeds max_seq={self.max_seq}')
         block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
+        aux_losses = []
         for index in range(self.layers):
-            hidden = block_cls(self.heads, self.mlp_ratio, self.dropout,
-                               compute_dtype, attention=self.attention,
-                               mesh=self.mesh, attn_dropout=self.attn_dropout,
-                               name=f'h_{index}')(hidden, train)
+            is_moe = (self.moe_experts > 0
+                      and index % self.moe_every == self.moe_every - 1)
+            block = block_cls(self.heads, self.mlp_ratio, self.dropout,
+                              compute_dtype, attention=self.attention,
+                              mesh=self.mesh, attn_dropout=self.attn_dropout,
+                              moe_experts=self.moe_experts if is_moe else 0,
+                              moe_k=self.moe_k,
+                              moe_capacity_factor=self.moe_capacity_factor,
+                              name=f'h_{index}')
+            result = block(hidden, train)
+            if is_moe:
+                hidden, aux = result
+                aux_losses.append(aux)
+            else:
+                hidden = result
         hidden = nn.LayerNorm(dtype=jnp.float32, name='ln_f')(hidden)
         # tied LM head: logits against the token embedding table, f32 for
         # a numerically stable softmax/loss
-        return token_embedding.attend(hidden.astype(jnp.float32))
+        logits = token_embedding.attend(hidden.astype(jnp.float32))
+        if self.moe_experts:
+            # arity is fixed by configuration, not by which layers happened
+            # to be MoE, so the WithAuxLoss pairing can't be broken by a
+            # (layers, moe_every) combination that selects no layer
+            aux = jnp.mean(jnp.stack(aux_losses)) if aux_losses else jnp.float32(0)
+            return logits, aux
+        return logits
 
     @staticmethod
     def partition_rules():
@@ -159,6 +199,7 @@ class GPT2(nn.Module):
         qkv/fc split columns on ``model``; out/proj split rows (their
         all-reduce rides ICI); embeddings split the vocab/position table.
         """
+        from tpusystem.ops.moe import moe_partition_rules
         return (
             (r'attn/qkv/kernel$', P(None, 'model')),
             (r'attn/out/kernel$', P('model', None)),
@@ -166,7 +207,7 @@ class GPT2(nn.Module):
             (r'proj/kernel$', P('model', None)),
             (r'wte/embedding$', P('model', None)),
             (r'wpe/embedding$', P(None, 'model')),
-        )
+        ) + moe_partition_rules()
 
 
 register(GPT2, excluded_kwargs={'mesh'})
@@ -225,11 +266,10 @@ class GPT2Pipelined:
         return hidden.astype(jnp.dtype(self.dtype))
 
     def _head(self, params, hidden):
-        hidden = hidden.astype(jnp.float32)
-        mean = hidden.mean(-1, keepdims=True)
-        variance = ((hidden - mean) ** 2).mean(-1, keepdims=True)
-        hidden = (hidden - mean) * jax.lax.rsqrt(variance + 1e-6)
-        hidden = hidden * params['ln_f']['scale'] + params['ln_f']['bias']
+        # same ln_f the non-pipelined family uses, applied as a standalone
+        # module so the two variants cannot drift numerically
+        hidden = nn.LayerNorm(dtype=jnp.float32).apply(
+            {'params': params['ln_f']}, hidden.astype(jnp.float32))
         return hidden @ params['wte']['embedding'].T
 
     def _block_fn(self):

@@ -1,0 +1,147 @@
+"""Mixture-of-experts — expert parallelism over the ``expert`` mesh axis.
+
+The reference has only a dense MLP (SURVEY.md §2.4: "EP/MoE | absent");
+this module supplies the TPU-native design: experts live as one stacked
+weight tensor with a leading ``experts`` dimension sharded over the
+``expert`` mesh axis, and token routing is expressed as dense one-hot
+dispatch/combine einsums (the Switch-Transformer/GSPMD formulation). With
+the dispatched activations sharding-constrained to the expert axis, XLA
+inserts the all-to-alls over ICI itself — no hand-written collective.
+
+Capacity model: each expert processes at most
+``capacity = round(k * tokens / experts * capacity_factor)`` tokens per
+batch; overflow tokens fall through the residual connection (standard
+drop-token semantics). Router runs in float32 with a load-balance loss
+(Switch eq. 4) plus a router z-loss for logit stability; the layer returns
+``(output, aux_loss)`` and :class:`tpusystem.train.losses.WithAuxLoss`
+folds the aux term into any base criterion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpusystem.parallel.mesh import EXPERT
+
+
+def expert_capacity(tokens: int, experts: int, k: int,
+                    capacity_factor: float) -> int:
+    """Per-expert token budget (at least 1, at most all tokens)."""
+    return max(1, min(tokens, int(tokens * k * capacity_factor / experts)))
+
+
+def route_top_k(gates: jax.Array, k: int, capacity: int):
+    """Build dispatch/combine tensors from router probabilities.
+
+    Args:
+        gates: [tokens, experts] router probabilities (float32).
+        k: choices per token; chosen gates renormalize to sum to 1.
+        capacity: per-expert slot budget.
+
+    Returns:
+        dispatch: [tokens, experts, capacity] 0/1 routing tensor.
+        combine: same shape, dispatch weighted by the (renormalized) gate.
+        fraction: [experts] fraction of tokens whose *first* choice was the
+            expert (the load-balance loss term).
+
+    Slots are granted choice-major: every token's first choice is seated
+    before any second choice, and within a choice in token order — so drop
+    behavior is deterministic and first choices always win over overflow.
+    """
+    tokens, experts = gates.shape
+    top_gates, top_experts = jax.lax.top_k(gates, k)
+    top_gates = top_gates / (jnp.sum(top_gates, -1, keepdims=True) + 1e-9)
+
+    dispatch = jnp.zeros((tokens, experts, capacity), jnp.float32)
+    combine = jnp.zeros((tokens, experts, capacity), jnp.float32)
+    seated = jnp.zeros((experts,), jnp.float32)
+    for choice in range(k):
+        onehot = jax.nn.one_hot(top_experts[:, choice], experts)  # [N, E]
+        position = jnp.cumsum(onehot, axis=0) - 1 + seated
+        seated = seated + jnp.sum(onehot, axis=0)
+        fits = (position < capacity) * onehot
+        slot = jax.nn.one_hot(position.astype(jnp.int32), capacity)  # [N, E, C]
+        placed = fits[:, :, None] * slot
+        dispatch = dispatch + placed
+        combine = combine + placed * top_gates[:, choice][:, None, None]
+    first_choice = jax.nn.one_hot(top_experts[:, 0], experts)
+    fraction = jnp.mean(first_choice, axis=0)
+    return dispatch, combine, fraction
+
+
+class MoEMLP(nn.Module):
+    """Expert-parallel FFN: drop-in for the dense fc->gelu->proj block.
+
+    Returns ``(output, aux_loss)`` where ``aux_loss`` already carries the
+    configured coefficients. Weights are stacked [experts, ...] float32
+    masters cast to ``dtype`` per use; pass ``mesh`` to pin the dispatched
+    activations to the expert axis (otherwise GSPMD chooses).
+    """
+
+    experts: int
+    k: int = 2
+    mlp_ratio: int = 4
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+    balance_coef: float = 1e-2
+    z_coef: float = 1e-3
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, hidden):
+        batch_shape, dim = hidden.shape[:-1], hidden.shape[-1]
+        hidden_dim = self.mlp_ratio * dim
+        flat = hidden.reshape(-1, dim)
+        tokens = flat.shape[0]
+
+        router = self.param('router', nn.initializers.normal(0.02),
+                            (dim, self.experts), jnp.float32)
+        init = nn.initializers.lecun_normal()
+        w1 = self.param('w1', init, (self.experts, dim, hidden_dim), jnp.float32)
+        b1 = self.param('b1', nn.initializers.zeros, (self.experts, hidden_dim), jnp.float32)
+        w2 = self.param('w2', init, (self.experts, hidden_dim, dim), jnp.float32)
+        b2 = self.param('b2', nn.initializers.zeros, (self.experts, dim), jnp.float32)
+
+        logits = flat.astype(jnp.float32) @ router
+        gates = jax.nn.softmax(logits)
+        capacity = expert_capacity(tokens, self.experts, self.k,
+                                   self.capacity_factor)
+        dispatch, combine, fraction = route_top_k(gates, self.k, capacity)
+
+        # Switch load-balance loss: experts * <fraction_dispatched * mean_prob>
+        balance = self.experts * jnp.sum(fraction * jnp.mean(gates, axis=0))
+        z_term = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        aux = self.balance_coef * balance + self.z_coef * z_term
+
+        compute = jnp.dtype(self.dtype)
+        expert_in = jnp.einsum('nec,nd->ecd', dispatch.astype(compute),
+                               flat.astype(compute))
+        expert_in = self._constrain(expert_in)
+        grown = jnp.einsum('ecd,edh->ech', expert_in, w1.astype(compute))
+        grown = nn.gelu(grown + b1[:, None].astype(compute))
+        shrunk = jnp.einsum('ech,ehd->ecd', grown, w2.astype(compute))
+        shrunk = shrunk + b2[:, None].astype(compute)
+        shrunk = self._constrain(shrunk)
+        output = jnp.einsum('nec,ecd->nd', combine.astype(compute), shrunk)
+        return output.reshape(*batch_shape, dim).astype(hidden.dtype), aux
+
+    def _constrain(self, value):
+        if self.mesh is None or self.mesh.shape[EXPERT] == 1:
+            return value
+        sharding = NamedSharding(self.mesh, P(EXPERT, None, None))
+        return jax.lax.with_sharding_constraint(value, sharding)
+
+
+def moe_partition_rules():
+    """Sharding rules for stacked expert weights: experts over the
+    ``expert`` axis, FFN hidden over ``model`` (TP within an expert)."""
+    return (
+        (r'moe/w1$', P(EXPERT, None, 'model')),
+        (r'moe/b1$', P(EXPERT, 'model')),
+        (r'moe/w2$', P(EXPERT, 'model', None)),
+        (r'moe/b2$', P(EXPERT, None)),
+        (r'moe/router$', P()),
+    )

@@ -43,6 +43,22 @@ class MSELoss:
 
 
 @register
+class WithAuxLoss:
+    """Wrap a criterion for models whose outputs are ``(predictions, aux)``
+    — e.g. MoE models returning router load-balance losses
+    (:mod:`tpusystem.ops.moe`). The aux term (already scaled by the model's
+    coefficients) adds to the base loss; ``coef`` rescales it globally."""
+
+    def __init__(self, criterion, coef: float = 1.0):
+        self.criterion = criterion
+        self.coef = coef
+
+    def __call__(self, outputs, targets):
+        predictions, aux = outputs
+        return self.criterion(predictions, targets) + self.coef * aux
+
+
+@register
 class NextTokenLoss:
     """Causal LM loss: cross-entropy of logits[:, :-1] vs tokens[:, 1:],
     with padding mask support (pad id < 0 excluded)."""
