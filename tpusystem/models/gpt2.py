@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
-from tpusystem.ops.attention import dot_product_attention
+from tpusystem.ops.attention import attend
 from tpusystem.registry import register
 
 
@@ -57,25 +57,11 @@ class SelfAttention(nn.Module):
         query, key, value = jnp.split(qkv, 3, axis=-1)
         shape = hidden.shape[:2] + (self.heads, head_dim)
         query, key, value = (t.reshape(shape) for t in (query, key, value))
-        if self.kernel == 'flash':
-            from tpusystem.ops.pallas.flash import flash_attention
-            context = flash_attention(query, key, value, causal=True)
-        elif self.kernel in ('ring', 'ulysses'):
-            from tpusystem.ops.ring import ring_self_attention
-            if self.mesh is None:
-                raise ValueError(
-                    f'{self.kernel!r} attention needs a mesh with a seq axis '
-                    '(pass mesh=... to the model)')
-            context = ring_self_attention(query, key, value, self.mesh,
-                                          causal=True, variant=self.kernel)
-        elif self.kernel == 'xla':
-            context = dot_product_attention(
-                query, key, value, causal=True,
-                dropout=attn_dropout if train else 0.0,
-                dropout_rng=self.make_rng('dropout') if train and attn_dropout else None)
-        else:
-            raise ValueError(f'unknown attention kernel {self.kernel!r}; '
-                             "expected 'flash', 'xla', 'ring' or 'ulysses'")
+        dropout = attn_dropout if train else 0.0
+        context = attend(
+            query, key, value, kernel=self.kernel, mesh=self.mesh, causal=True,
+            dropout=dropout,
+            dropout_rng=self.make_rng('dropout') if dropout else None)
         context = context.reshape(hidden.shape)
         return nn.Dense(dim, dtype=self.dtype, name='out')(context)
 

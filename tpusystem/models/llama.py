@@ -1,0 +1,205 @@
+"""Llama-3 language model family (BASELINE.md workload ladder #5:
+"Llama-3 8B aggregate, sharded Service.handler" — BASELINE.json configs[4]).
+
+The reference framework ships only an MNIST MLP (SURVEY.md §2.2,
+``examples/tinysys/modules/mlp.py``); the 8B-scale decoder family is part of
+the capability level this framework must supply (SURVEY.md §6).
+
+TPU-first choices mirror :mod:`tpusystem.models.gpt2`: bfloat16 activations
+with float32 RMSNorm/softmax/loss, float32 master weights cast per-use, and
+Megatron-style partition rules shipped with the model so the
+``TensorParallel``/``FullyShardedDataParallel`` policies shard it without
+per-experiment configuration. Llama-specific pieces: rotary position
+embeddings (no learned position table), grouped-query attention (8 KV heads
+at 8B — KV broadcast happens inside
+:func:`tpusystem.ops.attention.dot_product_attention`), SwiGLU FFN, RMSNorm,
+no biases anywhere, untied LM head.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from tpusystem.ops.attention import attend
+from tpusystem.registry import register
+
+
+def rotary_embedding(positions: jax.Array, head_dim: int,
+                     theta: float = 500_000.0) -> tuple[jax.Array, jax.Array]:
+    """(cos, sin) tables of shape [len, head_dim/2], float32."""
+    frequencies = 1.0 / theta ** (
+        jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    angles = positions.astype(jnp.float32)[:, None] * frequencies[None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rotary(tensor: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate [batch, len, heads, head_dim] pairs (x_even, x_odd) by the
+    position angle. Runs in float32, returns in the input dtype."""
+    dtype = tensor.dtype
+    paired = tensor.astype(jnp.float32).reshape(*tensor.shape[:-1], -1, 2)
+    even, odd = paired[..., 0], paired[..., 1]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    rotated = jnp.stack(
+        (even * cos - odd * sin, even * sin + odd * cos), axis=-1)
+    return rotated.reshape(tensor.shape).astype(dtype)
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square normalization in float32 (bf16-safe)."""
+
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, hidden):
+        dtype = hidden.dtype
+        hidden = hidden.astype(jnp.float32)
+        scale = self.param('scale', nn.initializers.ones, (hidden.shape[-1],))
+        variance = jnp.mean(jnp.square(hidden), axis=-1, keepdims=True)
+        return (hidden * jax.lax.rsqrt(variance + self.epsilon)
+                * scale).astype(dtype)
+
+
+class LlamaAttention(nn.Module):
+    """Causal grouped-query attention with rotary embeddings.
+
+    ``kernel='xla'`` (default) keeps the separate KV-head count through
+    :func:`dot_product_attention` (which broadcasts KV over query-head
+    groups); 'flash'/'ring'/'ulysses' kernels take full-head tensors, so KV
+    is repeated up front for them.
+    """
+
+    heads: int
+    kv_heads: int
+    dtype: jnp.dtype
+    rope_theta: float = 500_000.0
+    kernel: str = 'xla'
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, hidden, train: bool = False):
+        dim = hidden.shape[-1]
+        head_dim = dim // self.heads
+        dense = lambda features, name: nn.Dense(
+            features, use_bias=False, dtype=self.dtype, name=name)
+        query = dense(self.heads * head_dim, 'q')(hidden)
+        key = dense(self.kv_heads * head_dim, 'k')(hidden)
+        value = dense(self.kv_heads * head_dim, 'v')(hidden)
+        batch, length = hidden.shape[:2]
+        query = query.reshape(batch, length, self.heads, head_dim)
+        key = key.reshape(batch, length, self.kv_heads, head_dim)
+        value = value.reshape(batch, length, self.kv_heads, head_dim)
+
+        cos, sin = rotary_embedding(jnp.arange(length), head_dim,
+                                    self.rope_theta)
+        query = apply_rotary(query, cos, sin)
+        key = apply_rotary(key, cos, sin)
+
+        context = attend(query, key, value, kernel=self.kernel,
+                         mesh=self.mesh, causal=True)
+        context = context.reshape(batch, length, dim)
+        return dense(dim, 'out')(context)
+
+
+class LlamaBlock(nn.Module):
+    """Pre-RMSNorm transformer block with a SwiGLU FFN."""
+
+    heads: int
+    kv_heads: int
+    ffn_dim: int
+    dtype: jnp.dtype
+    rope_theta: float = 500_000.0
+    attention: str = 'xla'
+    mesh: object = None
+
+    @nn.compact
+    def __call__(self, hidden, train: bool = False):
+        dim = hidden.shape[-1]
+        normed = RMSNorm(name='attn_norm')(hidden)
+        hidden = hidden + LlamaAttention(
+            self.heads, self.kv_heads, self.dtype, self.rope_theta,
+            kernel=self.attention, mesh=self.mesh, name='attn')(normed, train)
+        normed = RMSNorm(name='ffn_norm')(hidden)
+        dense = lambda features, name: nn.Dense(
+            features, use_bias=False, dtype=self.dtype, name=name)
+        gated = nn.silu(dense(self.ffn_dim, 'gate')(normed)) \
+            * dense(self.ffn_dim, 'up')(normed)
+        return hidden + dense(dim, 'down')(gated)
+
+
+class Llama(nn.Module):
+    """Llama-3-style decoder-only transformer.
+
+    Defaults are the 8B shape (vocab 128256, 32 x 4096, 32 heads / 8 KV
+    heads, SwiGLU 14336, RoPE theta 5e5). Use :func:`llama3_8b` /
+    :func:`llama_tiny` presets.
+    """
+
+    vocab_size: int = 128_256
+    layers: int = 32
+    dim: int = 4096
+    heads: int = 32
+    kv_heads: int = 8
+    ffn_dim: int = 14_336
+    max_seq: int = 8192
+    rope_theta: float = 500_000.0
+    dtype: str = 'bfloat16'
+    attention: str = 'xla'
+    mesh: object = None
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        compute_dtype = jnp.dtype(self.dtype)
+        assert tokens.shape[-1] <= self.max_seq, (
+            f'sequence length {tokens.shape[-1]} exceeds max_seq={self.max_seq}')
+        hidden = nn.Embed(self.vocab_size, self.dim, dtype=jnp.float32,
+                          name='embed')(tokens)
+        hidden = hidden.astype(compute_dtype)
+        block_cls = (nn.remat(LlamaBlock, static_argnums=(2,))
+                     if self.remat else LlamaBlock)
+        for index in range(self.layers):
+            hidden = block_cls(self.heads, self.kv_heads, self.ffn_dim,
+                               compute_dtype, self.rope_theta,
+                               attention=self.attention, mesh=self.mesh,
+                               name=f'layer_{index}')(hidden, train)
+        hidden = RMSNorm(name='final_norm')(hidden)
+        # untied head (Llama-3 convention), f32 for a stable softmax/loss
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name='lm_head')(hidden.astype(jnp.float32))
+
+    @staticmethod
+    def partition_rules():
+        """Megatron-style TP rules: q/k/v/gate/up split columns on ``model``;
+        out/down split rows (their all-reduce rides ICI); embedding and head
+        split the vocab dimension."""
+        return (
+            (r'attn/(q|k|v)/kernel$', P(None, 'model')),
+            (r'attn/out/kernel$', P('model', None)),
+            (r'(gate|up)/kernel$', P(None, 'model')),
+            (r'down/kernel$', P('model', None)),
+            (r'embed/embedding$', P('model', None)),
+            (r'lm_head/kernel$', P(None, 'model')),
+        )
+
+
+register(Llama, excluded_kwargs={'mesh'})
+
+
+def llama3_8b(**overrides) -> Llama:
+    """The 8B preset (== class defaults), gradient checkpointing on."""
+    config = dict(remat=True)
+    config.update(overrides)
+    return Llama(**config)
+
+
+def llama_tiny(**overrides) -> Llama:
+    """Test/dry-run scale: compiles in seconds on CPU."""
+    config = dict(vocab_size=256, layers=2, dim=64, heads=4, kv_heads=2,
+                  ffn_dim=128, max_seq=128)
+    config.update(overrides)
+    return Llama(**config)

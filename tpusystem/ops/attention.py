@@ -25,6 +25,41 @@ def causal_mask(query_length: int, key_length: int,
     return query_positions >= key_positions
 
 
+def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
+           causal: bool = True, dropout: float = 0.0, dropout_rng=None):
+    """Kernel dispatch shared by the model families.
+
+    ``'xla'`` routes to :func:`dot_product_attention` (GSPMD-shardable,
+    GQA-aware, optional probability dropout). ``'flash'`` is the Pallas
+    O(seq)-memory kernel; ``'ring'``/``'ulysses'`` are the sequence-parallel
+    variants (need ``mesh`` with a seq axis). Non-xla kernels take full-head
+    tensors, so grouped KV is repeated up to the query head count first.
+    """
+    if kernel == 'xla':
+        return dot_product_attention(query, key, value, causal=causal,
+                                     dropout=dropout, dropout_rng=dropout_rng)
+    if dropout:
+        raise ValueError("attention-probability dropout is only implemented "
+                         f"on the 'xla' kernel, not {kernel!r}")
+    if key.shape[2] != query.shape[2]:
+        group = query.shape[2] // key.shape[2]
+        key = jnp.repeat(key, group, axis=2)
+        value = jnp.repeat(value, group, axis=2)
+    if kernel == 'flash':
+        from tpusystem.ops.pallas.flash import flash_attention
+        return flash_attention(query, key, value, causal=causal)
+    if kernel in ('ring', 'ulysses'):
+        from tpusystem.ops.ring import ring_self_attention
+        if mesh is None:
+            raise ValueError(
+                f'{kernel!r} attention needs a mesh with a seq axis '
+                '(pass mesh=... to the model)')
+        return ring_self_attention(query, key, value, mesh,
+                                   causal=causal, variant=kernel)
+    raise ValueError(f'unknown attention kernel {kernel!r}; '
+                     "expected 'xla', 'flash', 'ring' or 'ulysses'")
+
+
 def dot_product_attention(query, key, value, *, causal: bool = True,
                           mask=None, scale: float | None = None,
                           dropout: float = 0.0, dropout_rng=None):
