@@ -25,6 +25,19 @@ def causal_mask(query_length: int, key_length: int,
     return query_positions >= key_positions
 
 
+def repeat_kv_heads(query, key, value):
+    """Broadcast grouped KV heads up to the query head count (Llama-3 GQA).
+    The single implementation behind every attention kernel."""
+    query_heads, kv_heads = query.shape[2], key.shape[2]
+    if kv_heads == query_heads:
+        return key, value
+    assert query_heads % kv_heads == 0, (
+        f'query heads ({query_heads}) must be a multiple of KV heads '
+        f'({kv_heads}) for grouped-query attention')
+    group = query_heads // kv_heads
+    return jnp.repeat(key, group, axis=2), jnp.repeat(value, group, axis=2)
+
+
 def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
            causal: bool = True, dropout: float = 0.0, dropout_rng=None):
     """Kernel dispatch shared by the model families.
@@ -41,15 +54,12 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
     if dropout:
         raise ValueError("attention-probability dropout is only implemented "
                          f"on the 'xla' kernel, not {kernel!r}")
-    if key.shape[2] != query.shape[2]:
-        group = query.shape[2] // key.shape[2]
-        key = jnp.repeat(key, group, axis=2)
-        value = jnp.repeat(value, group, axis=2)
-    if kernel == 'flash':
+    if kernel == 'flash':  # flash broadcasts GQA heads itself
         from tpusystem.ops.pallas.flash import flash_attention
         return flash_attention(query, key, value, causal=causal)
     if kernel in ('ring', 'ulysses'):
         from tpusystem.ops.ring import ring_self_attention
+        key, value = repeat_kv_heads(query, key, value)
         if mesh is None:
             raise ValueError(
                 f'{kernel!r} attention needs a mesh with a seq axis '
@@ -72,15 +82,8 @@ def dot_product_attention(query, key, value, *, causal: bool = True,
     """
     input_dtype = query.dtype
     head_dim = query.shape[-1]
-    query_heads = query.shape[2]
-    kv_heads = key.shape[2]
     scale = scale if scale is not None else head_dim ** -0.5
-
-    if kv_heads != query_heads:
-        assert query_heads % kv_heads == 0, (query_heads, kv_heads)
-        group = query_heads // kv_heads
-        key = jnp.repeat(key, group, axis=2)
-        value = jnp.repeat(value, group, axis=2)
+    key, value = repeat_kv_heads(query, key, value)
 
     scores = jnp.einsum('bqhd,bkhd->bhqk', query, key,
                         preferred_element_type=jnp.float32) * scale

@@ -307,11 +307,8 @@ def flash_attention(query, key, value, *, causal: bool = True,
         interpret = jax.default_backend() not in ('tpu', 'axon')
 
     batch, seq_q, q_heads, head_dim = query.shape
-    kv_heads = key.shape[2]
-    if kv_heads != q_heads:
-        group = q_heads // kv_heads
-        key = jnp.repeat(key, group, axis=2)
-        value = jnp.repeat(value, group, axis=2)
+    from tpusystem.ops.attention import repeat_kv_heads
+    key, value = repeat_kv_heads(query, key, value)
     scale = scale if scale is not None else head_dim ** -0.5
 
     sizes = _block_sizes(seq_q, key.shape[1], block_q, block_kv)
