@@ -1,0 +1,162 @@
+"""Aux subsystems: event ledger (race detection), profiling, typed config.
+
+The reference lacks all three (SURVEY.md §5); these tests pin the mechanisms
+the TPU build supplies instead.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tpusystem import config
+from tpusystem.observe import EventLedger, LedgerDivergence, StepTimer
+from tpusystem.observe.events import StepTimed
+from tpusystem.parallel.multihost import Loopback
+from tpusystem.registry import Registry, gethash
+from tpusystem.services.prodcon import Consumer, Producer, event
+
+
+@event
+class EpochDone:
+    epoch: int
+    loss: float
+
+
+class TestEventLedger:
+    def test_identical_streams_identical_digests(self):
+        ledgers = [EventLedger(), EventLedger()]
+        for ledger in ledgers:
+            ledger.record(EpochDone(epoch=0, loss=0.5))
+            ledger.record(EpochDone(epoch=1, loss=0.4))
+        assert ledgers[0].digest == ledgers[1].digest
+        assert ledgers[0].count == 2
+
+    def test_order_divergence_changes_digest(self):
+        forward, backward = EventLedger(), EventLedger()
+        first, second = EpochDone(0, 0.5), EpochDone(1, 0.4)
+        forward.record(first), forward.record(second)
+        backward.record(second), backward.record(first)
+        assert forward.digest != backward.digest
+
+    def test_float_noise_is_ignored_unless_strict(self):
+        lenient = [EventLedger(), EventLedger()]
+        lenient[0].record(EpochDone(epoch=0, loss=0.5))
+        lenient[1].record(EpochDone(epoch=0, loss=0.500001))
+        assert lenient[0].digest == lenient[1].digest
+
+        strict = [EventLedger(strict=True), EventLedger(strict=True)]
+        strict[0].record(EpochDone(epoch=0, loss=0.5))
+        strict[1].record(EpochDone(epoch=0, loss=0.75))
+        assert strict[0].digest != strict[1].digest
+
+    def test_tap_records_every_dispatch(self):
+        producer = Producer()
+        producer.register(Consumer())
+        ledger = EventLedger().tap(producer)
+        producer.dispatch(EpochDone(epoch=0, loss=0.1))
+        producer.dispatch(EpochDone(epoch=1, loss=0.2))
+        assert ledger.count == 2
+
+    def test_verify_unanimous_on_loopback(self):
+        ledger = EventLedger()
+        ledger.record(EpochDone(epoch=0, loss=0.1))
+        assert ledger.verify(Loopback()) == ledger.digest
+
+    def test_verify_raises_on_divergence(self):
+        class SplitBrain:
+            rank = 0
+
+            def gather(self, value):
+                return [value, (1, 99, 'deadbeef' * 8)]
+
+        ledger = EventLedger()
+        ledger.record(EpochDone(epoch=0, loss=0.1))
+        with pytest.raises(LedgerDivergence, match='diverged'):
+            ledger.verify(SplitBrain())
+
+
+class TestStepTimer:
+    def test_emits_step_timed_event(self):
+        producer = Producer()
+        seen = []
+        consumer = Consumer()
+        consumer.register(StepTimed, seen.append)
+        producer.register(consumer)
+
+        timer = StepTimer(producer).start()
+        timed = timer.stop(model=object(), phase='train', steps=100)
+        assert seen == [timed]
+        assert timed.steps == 100 and timed.seconds >= 0
+        assert timed.steps_per_second > 0
+
+    def test_stop_without_start_raises(self):
+        with pytest.raises(RuntimeError):
+            StepTimer().stop(model=None, phase='train', steps=1)
+
+
+class TestConfig:
+    def setup_method(self):
+        self.registry = Registry()
+
+        @self.registry.register
+        class Tokenizer:
+            def __init__(self, vocab: int = 256):
+                self.vocab = vocab
+
+        @self.registry.register
+        class Normalizer:
+            def __init__(self):
+                pass
+
+        @self.registry.register
+        class Model:
+            def __init__(self, dim: int, tokenizer=None, normalizer=None, tags=None):
+                self.dim = dim
+                self.tokenizer = tokenizer
+                self.normalizer = normalizer
+                self.tags = tags
+
+        self.Tokenizer, self.Normalizer, self.Model = Tokenizer, Normalizer, Model
+
+    def test_build_resolves_nested_specs(self):
+        model = config.build({
+            'name': 'Model',
+            'arguments': {
+                'dim': 64,
+                'tokenizer': {'name': 'Tokenizer', 'arguments': {'vocab': 512}},
+                'normalizer': 'Normalizer',  # collapsed argless form
+                'tags': ['a', 'b'],
+            },
+        }, self.registry)
+        assert isinstance(model, self.Model) and model.dim == 64
+        assert isinstance(model.tokenizer, self.Tokenizer)
+        assert model.tokenizer.vocab == 512
+        assert isinstance(model.normalizer, self.Normalizer)
+        assert model.tags == ['a', 'b']
+
+    def test_unknown_type_fails_loudly(self):
+        with pytest.raises(KeyError, match='Mystery'):
+            config.build({'name': 'Mystery', 'arguments': {}}, self.registry)
+
+    def test_snapshot_build_roundtrip_preserves_identity(self):
+        model = self.Model(dim=32, tokenizer=self.Tokenizer(vocab=128))
+        spec = config.snapshot(model)
+        rebuilt = config.build(spec, self.registry)
+        assert gethash(rebuilt) == gethash(model)
+        assert rebuilt.tokenizer.vocab == 128
+
+    def test_plain_strings_pass_through(self):
+        model = config.build(
+            {'name': 'Model', 'arguments': {'dim': 8, 'tags': 'not-a-type'}},
+            self.registry)
+        assert model.tags == 'not-a-type'
+
+    def test_load_json_and_toml(self, tmp_path):
+        json_path = tmp_path / 'model.json'
+        json_path.write_text('{"name": "Model", "arguments": {"dim": 4}}')
+        assert config.load(json_path)['arguments']['dim'] == 4
+
+        toml_path = tmp_path / 'model.toml'
+        toml_path.write_text('name = "Model"\n[arguments]\ndim = 4\n')
+        spec = config.load(toml_path)
+        assert config.build(spec, self.registry).dim == 4

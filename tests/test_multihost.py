@@ -1,0 +1,315 @@
+"""Control-plane tests: a 3-host pod simulated with in-process transports.
+
+The reference has no distributed machinery at all (SURVEY.md §2.4) — its
+buses are in-process calls. These tests pin the distributed generalization:
+wired events reach every host, collectives complete in rank-uniform order,
+stop decisions are collectively agreed, and a silent host surfaces as a
+``WorkerLost`` domain event.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tpusystem.parallel.multihost import (
+    DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
+    WorkerLost, agree,
+)
+from tpusystem.services.prodcon import Consumer, event
+from tpusystem.services.pubsub import Subscriber
+
+
+@event
+class Synced:
+    epoch: int
+    loss: float
+
+
+def pod(size, **kwargs):
+    hub = Hub(size, **kwargs)
+    transports = [TcpTransport(hub.address, rank, size) for rank in range(size)]
+    deadline = time.monotonic() + 5
+    while len(hub._clients) < size and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(hub._clients) == size
+    return hub, transports
+
+
+def shutdown(hub, transports):
+    for transport in transports:
+        transport.close()
+    hub.close()
+
+
+def wait_until(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+class TestTransport:
+    def test_wired_event_reaches_every_other_host(self):
+        hub, transports = pod(3)
+        try:
+            seen = {rank: [] for rank in range(3)}
+            for rank, transport in enumerate(transports):
+                transport.subscribe('test', seen[rank].append)
+            transports[1].send_event('test', {'from': 1})
+            assert wait_until(lambda: seen[0] and seen[2])
+            assert seen[0] == [{'from': 1}] and seen[2] == [{'from': 1}]
+            assert seen[1] == []  # sender does not hear itself
+        finally:
+            shutdown(hub, transports)
+
+    def test_channels_do_not_crosstalk(self):
+        hub, transports = pod(2)
+        try:
+            alpha, beta = [], []
+            transports[1].subscribe('alpha', alpha.append)
+            transports[1].subscribe('beta', beta.append)
+            transports[0].send_event('alpha', 'a')
+            transports[0].send_event('gamma', 'dropped')  # no subscriber
+            transports[0].send_event('beta', 'b')
+            assert wait_until(lambda: alpha and beta)
+            assert alpha == ['a'] and beta == ['b']
+        finally:
+            shutdown(hub, transports)
+
+    def test_allreduce_ops(self):
+        hub, transports = pod(3)
+        try:
+            import threading
+            results = {}
+
+            def run(rank, transport):
+                results[('or', rank)] = transport.allreduce(rank == 2, op='or')
+                results[('and', rank)] = transport.allreduce(True, op='and')
+                results[('sum', rank)] = transport.allreduce(rank, op='sum')
+                results[('gather', rank)] = sorted(transport.gather(rank))
+
+            threads = [threading.Thread(target=run, args=(rank, transport))
+                       for rank, transport in enumerate(transports)]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join(timeout=10)
+            for rank in range(3):
+                assert results[('or', rank)] is True
+                assert results[('and', rank)] is True
+                assert results[('sum', rank)] == 3
+                assert results[('gather', rank)] == [0, 1, 2]
+        finally:
+            shutdown(hub, transports)
+
+    def test_loopback_degenerate_case(self):
+        transport = Loopback()
+        assert transport.allreduce(True, op='and') is True
+        assert transport.allreduce(False, op='or') is False
+        assert transport.gather(7) == [7]
+        transport.barrier()
+        transport.send_event('any', 'dropped')  # nowhere to go, no error
+
+    def test_connect_retries_until_hub_listens(self):
+        import socket as socket_module
+        import threading
+        placeholder = socket_module.socket()
+        placeholder.bind(('127.0.0.1', 0))
+        address = placeholder.getsockname()
+        placeholder.close()                       # port free, nothing listening
+        box = {}
+
+        def dial():
+            box['transport'] = TcpTransport(address, 0, 1, connect_timeout=10)
+
+        dialer = threading.Thread(target=dial, daemon=True)
+        dialer.start()                            # client starts BEFORE the hub
+        time.sleep(0.3)
+        hub = Hub(1, host=address[0], port=address[1])
+        try:
+            dialer.join(timeout=10)
+            assert 'transport' in box
+            assert wait_until(lambda: len(hub._clients) == 1)
+        finally:
+            box['transport'].close()
+            hub.close()
+
+
+class TestDistributedProducer:
+    def test_wired_events_cross_hosts_on_drain(self):
+        hub, transports = pod(2)
+        try:
+            producers = [DistributedProducer(transport) for transport in transports]
+            logs = {0: [], 1: []}
+            for rank, producer in enumerate(producers):
+                consumer = Consumer()
+
+                def make(rank):
+                    def on_synced(message: Synced):
+                        logs[rank].append(message)
+                    return on_synced
+                consumer.register(Synced, make(rank))
+                producer.register(consumer)
+                producer.wire(Synced)
+
+            producers[0].dispatch(Synced(epoch=1, loss=0.5))
+            assert logs[0] == [Synced(1, 0.5)]  # local, synchronous
+            assert wait_until(lambda: not producers[1]._inbox.empty())
+            assert logs[1] == []  # remote events wait for a safe point
+            assert producers[1].drain() == 1
+            assert logs[1] == [Synced(1, 0.5)]
+        finally:
+            shutdown(hub, transports)
+
+    def test_unwired_events_stay_local(self):
+        hub, transports = pod(2)
+        try:
+            producers = [DistributedProducer(transport) for transport in transports]
+            producers[0].dispatch(Synced(epoch=1, loss=0.5))
+            time.sleep(0.1)
+            assert producers[1].drain() == 0
+        finally:
+            shutdown(hub, transports)
+
+    def test_primary_only_consumer_skipped_off_primary(self):
+        hub, transports = pod(2)
+        try:
+            producers = [DistributedProducer(transport) for transport in transports]
+            for producer in producers:
+                producer.register(Consumer(), primary_only=True)
+            assert len(producers[0].consumers) == 1
+            assert len(producers[1].consumers) == 0
+        finally:
+            shutdown(hub, transports)
+
+    def test_loopback_producer_is_plain_producer(self):
+        producer = DistributedProducer()
+        seen = []
+        consumer = Consumer()
+        consumer.register(Synced, seen.append)
+        producer.register(consumer, primary_only=True)  # rank 0 -> registered
+        producer.wire(Synced)
+        producer.dispatch(Synced(epoch=0, loss=1.0))
+        assert seen == [Synced(0, 1.0)]
+        assert producer.drain() == 0
+
+
+class TestDistributedPublisher:
+    def test_wired_topic_crosses_hosts(self):
+        hub, transports = pod(2)
+        try:
+            publishers = [DistributedPublisher(transport) for transport in transports]
+            received = []
+            subscriber = Subscriber()
+            subscriber.register('loss', received.append)
+            publishers[1].register(subscriber)
+            publishers[1].wire('loss')
+            publishers[0].wire('loss')
+
+            publishers[0].publish(0.25, 'loss')
+            assert wait_until(lambda: not publishers[1]._inbox.empty())
+            publishers[1].drain()
+            assert received == [0.25]
+        finally:
+            shutdown(hub, transports)
+
+
+class TestAgreement:
+    def test_any_host_stops_all(self):
+        hub, transports = pod(3)
+        try:
+            import threading
+            verdicts = {}
+
+            def run(rank, transport):
+                verdicts[rank] = agree(transport, rank == 1)  # host 1 wants out
+
+            threads = [threading.Thread(target=run, args=(rank, transport))
+                       for rank, transport in enumerate(transports)]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join(timeout=10)
+            assert verdicts == {0: True, 1: True, 2: True}
+        finally:
+            shutdown(hub, transports)
+
+    def test_unanimous_op_requires_all(self):
+        assert agree(Loopback(), False, op='and') is False
+        assert agree(Loopback(), True, op='and') is True
+
+
+class TestSharedTransport:
+    def test_producer_and_publisher_share_one_transport(self):
+        """The Runtime wiring: both buses on the same TcpTransport, each
+        draining only its own channel's traffic."""
+        hub, transports = pod(2)
+        try:
+            producers = [DistributedProducer(transport) for transport in transports]
+            publishers = [DistributedPublisher(transport) for transport in transports]
+            for producer in producers:
+                producer.wire(Synced)
+            for publisher in publishers:
+                publisher.wire('loss')
+            events, topics = [], []
+            consumer = Consumer()
+            consumer.register(Synced, events.append)
+            producers[1].register(consumer)
+            subscriber = Subscriber()
+            subscriber.register('loss', topics.append)
+            publishers[1].register(subscriber)
+
+            producers[0].dispatch(Synced(epoch=7, loss=0.1))
+            publishers[0].publish(0.25, 'loss')
+            assert wait_until(lambda: not producers[1]._inbox.empty()
+                              and not publishers[1]._inbox.empty())
+            assert producers[1].drain() == 1
+            assert publishers[1].drain() == 1
+            assert events == [Synced(7, 0.1)]
+            assert topics == [0.25]
+        finally:
+            shutdown(hub, transports)
+
+
+class TestFailureDetection:
+    def test_crashed_worker_surfaces_immediately(self):
+        """A dead connection (no 'bye') is a crash: lost is broadcast at
+        once, without waiting for the heartbeat monitor."""
+        hub, transports = pod(2)
+        try:
+            producer = DistributedProducer(transports[0])
+            lost = []
+            consumer = Consumer()
+            consumer.register(WorkerLost, lost.append)
+            producer.register(consumer)
+            transports[1]._sock.close()          # crash: socket dies, no bye
+            assert wait_until(lambda: not producer._inbox.empty())
+            producer.drain()
+            assert lost and lost[0].rank == 1
+        finally:
+            transports[0].close()
+            hub.close()
+
+    def test_silent_host_surfaces_as_worker_lost_event(self):
+        hub = Hub(2, heartbeat_timeout=0.3)
+        transports = [
+            TcpTransport(hub.address, 0, 2, heartbeat_interval=0.05),
+            TcpTransport(hub.address, 1, 2),  # never heartbeats
+        ]
+        try:
+            assert wait_until(lambda: len(hub._clients) == 2)
+            producer = DistributedProducer(transports[0])
+            lost = []
+            consumer = Consumer()
+            consumer.register(WorkerLost, lost.append)
+            producer.register(consumer)
+            # rank 1 stays silent past the timeout
+            assert wait_until(lambda: not producer._inbox.empty(), timeout=5)
+            producer.drain()
+            assert lost and lost[0].rank == 1
+        finally:
+            shutdown(hub, transports)

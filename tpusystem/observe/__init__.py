@@ -12,7 +12,9 @@ has exactly one device→host sync per phase (``metrics.compute()``).
 """
 
 from tpusystem.observe.events import Iterated, StepTimed, Trained, Validated
+from tpusystem.observe.ledger import EventLedger, LedgerDivergence
 from tpusystem.observe.logs import logging_consumer
+from tpusystem.observe.profile import StepTimer, annotate, step_span, trace
 from tpusystem.observe.tensorboard import SummaryWriter, tensorboard_consumer
 from tpusystem.observe.tracking import (
     experiment, metrics_store, models_store, modules_store, iterations_store,
@@ -24,4 +26,6 @@ __all__ = [
     'logging_consumer', 'SummaryWriter', 'tensorboard_consumer',
     'tracking_consumer', 'experiment', 'metrics_store', 'models_store',
     'modules_store', 'iterations_store', 'repository',
+    'EventLedger', 'LedgerDivergence', 'StepTimer', 'annotate', 'step_span',
+    'trace',
 ]

@@ -1,0 +1,89 @@
+"""Tracing/profiling subsystem.
+
+The reference has none — its nearest artifacts are TensorBoard scalars and
+per-100-batch loss logs (SURVEY.md §5 "tracing/profiling: absent"). Here
+profiling is a first-class citizen with two faces:
+
+- **device plane** — :func:`trace` / :func:`annotate` / :func:`step_span`
+  wrap ``jax.profiler`` so XLA traces (HLO timelines, memory, TPU util)
+  land in a TensorBoard-readable logdir. Annotations are zero-cost when no
+  trace is active, so they stay in production code.
+- **bus plane** — :class:`StepTimer` measures host wall-clock around jitted
+  step spans and emits :class:`~tpusystem.observe.events.StepTimed` events;
+  any consumer (logging, storage, TensorBoard) observes throughput without
+  the trainer knowing its observers — the reference's architecture point,
+  applied to profiling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Iterator
+
+import jax
+
+from tpusystem.observe.events import StepTimed
+from tpusystem.services.prodcon import Producer
+
+
+@contextlib.contextmanager
+def trace(logdir: str) -> Iterator[None]:
+    """Capture a device trace (XLA timeline, memory viewer) for the enclosed
+    span into ``logdir``; open with TensorBoard's profile plugin."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str) -> Any:
+    """Named span on the host timeline of an active trace (no-op otherwise)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_span(name: str, step: int) -> Any:
+    """Step-correlated span: lets the profiler group device ops per training
+    step (``jax.profiler.StepTraceAnnotation``)."""
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+class StepTimer:
+    """Wall-clock throughput measurement around step spans.
+
+    The timer brackets a *span* of steps — never a single one; timing a
+    single step would force a device sync per step and destroy MFU
+    (SURVEY.md §7.3 "keeping the bus off the hot path"). ``stop`` blocks on
+    ``result`` (any device value from the last step) so the measurement
+    covers real device work rather than async dispatch.
+
+    Example::
+
+        timer = StepTimer(producer)
+        timer.start()
+        for batch in loader:
+            state, out = step(state, batch)
+        timer.stop(model, 'train', steps=len(loader), result=out)
+    """
+
+    def __init__(self, producer: Producer | None = None) -> None:
+        self.producer = producer
+        self._started: float | None = None
+
+    def start(self) -> 'StepTimer':
+        self._started = time.perf_counter()
+        return self
+
+    def stop(self, model: Any, phase: str, steps: int,
+             result: Any = None) -> StepTimed:
+        if self._started is None:
+            raise RuntimeError('StepTimer.stop() without start()')
+        if result is not None:
+            jax.block_until_ready(result)
+        timed = StepTimed(model=model, phase=phase, steps=steps,
+                          seconds=time.perf_counter() - self._started)
+        self._started = None
+        if self.producer is not None:
+            self.producer.dispatch(timed)
+        return timed

@@ -2,6 +2,10 @@ from tpusystem.parallel.mesh import (
     AXES, DATA, EXPERT, FSDP, MODEL, SEQ, STAGE,
     MeshSpec, batch_sharding, replicated, single_device_mesh,
 )
+from tpusystem.parallel.multihost import (
+    DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
+    World, WorkerJoined, WorkerLost, agree, connect, world,
+)
 from tpusystem.parallel.pipeline import PipelineParallel, pipeline_apply
 from tpusystem.parallel.sharding import (
     DataParallel, FullyShardedDataParallel, ShardingPolicy, TensorParallel,
@@ -10,4 +14,7 @@ from tpusystem.parallel.sharding import (
 __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'ShardingPolicy', 'DataParallel', 'FullyShardedDataParallel',
            'TensorParallel', 'PipelineParallel', 'pipeline_apply',
-           'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE']
+           'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE',
+           'World', 'world', 'connect', 'agree', 'Hub', 'Loopback',
+           'TcpTransport', 'DistributedProducer', 'DistributedPublisher',
+           'WorkerLost', 'WorkerJoined']

@@ -1,0 +1,570 @@
+"""Multi-host control plane: the event buses, across TPU-VM workers.
+
+The reference's Producer/Consumer and Publisher/Subscriber buses are
+in-process method calls (``torchsystem/services/prodcon.py:209-218``,
+``torchsystem/services/pubsub.py:206-215``) — the degenerate single-host
+case. On a pod each host runs its own Python process; domain events raised
+on one worker (metrics, Trained/Validated, stop requests) must reach
+consumers anywhere, and stop decisions must be *collectively agreed* or
+hosts deadlock in XLA collectives (SURVEY.md §7.3 "events across hosts").
+
+Two planes, by design:
+
+- **data plane** — tensors move via XLA collectives over ICI/DCN, inserted
+  by GSPMD from sharding annotations (:mod:`tpusystem.parallel.sharding`).
+  This module never touches device arrays.
+- **control plane** (this module) — small, host-side, already-materialized
+  Python values move over a TCP star: the primary host runs a :class:`Hub`
+  router; every host (primary included) attaches a :class:`TcpTransport`
+  client. The same API degrades to :class:`Loopback` in one process, so
+  training code is identical on a laptop and on a pod.
+
+Capabilities:
+
+- :class:`DistributedProducer` / :class:`DistributedPublisher` — drop-in
+  supersets of the in-process buses. Events whose types are ``wire()``-d are
+  forwarded to every other host; consumers may be registered
+  ``primary_only`` so storage/TensorBoard run exactly once per experiment
+  (SURVEY.md §5 "only rank-0 runs storage/TB consumers").
+- :func:`agree` — boolean all-reduce over hosts: the early-stop commit
+  point. One host's ``StopTraining`` becomes everyone's.
+- heartbeat failure detection — the hub tracks per-host liveness and
+  broadcasts :class:`WorkerLost` as a *domain event* when a host goes
+  silent, so recovery policy is just another consumer (SURVEY.md §5
+  "failure detection").
+
+Transport frames are length-prefixed pickles on a trusted cluster network
+(the same trust model as NCCL/MPI bootstrap); event payloads must be plain
+host values — never device arrays.
+"""
+
+from __future__ import annotations
+
+import itertools
+import pickle
+import queue
+import socket
+import struct
+import threading
+import time
+from collections.abc import Callable
+from dataclasses import dataclass, field
+from typing import Any
+
+from tpusystem.services.prodcon import Consumer, Producer, event
+from tpusystem.services.pubsub import Publisher, Subscriber
+
+# ---------------------------------------------------------------------------
+# world
+
+
+@dataclass(frozen=True)
+class World:
+    """Host-level topology facts (not chips — processes)."""
+    process_index: int
+    process_count: int
+
+    @property
+    def is_primary(self) -> bool:
+        return self.process_index == 0
+
+
+def world() -> World:
+    """The JAX runtime's view of the multi-host job (1 process off-pod)."""
+    import jax
+    return World(jax.process_index(), jax.process_count())
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> World:
+    """Join the multi-host job (wraps ``jax.distributed.initialize``).
+
+    No-op when the job is single-process and no coordinator is given, so the
+    same ``main()`` runs unchanged off-pod.
+    """
+    import jax
+    if coordinator_address is not None or (num_processes or 1) > 1:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    return world()
+
+
+# ---------------------------------------------------------------------------
+# control-plane events
+
+
+@event
+class WorkerLost:
+    """A host stopped heartbeating; consumers decide the recovery policy
+    (checkpoint-restore restart, mesh re-init, abort)."""
+    rank: int
+    last_seen: float
+
+
+@event
+class WorkerJoined:
+    """A host attached to the control plane."""
+    rank: int
+
+
+# ---------------------------------------------------------------------------
+# wire format
+
+_LEN = struct.Struct('>Q')
+
+
+def _send_frame(sock: socket.socket, payload: tuple) -> None:
+    blob = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    chunks = []
+    while n:
+        chunk = sock.recv(n)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b''.join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> tuple | None:
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    blob = _recv_exact(sock, _LEN.unpack(header)[0])
+    return None if blob is None else pickle.loads(blob)
+
+
+_REDUCERS: dict[str, Callable[[list], Any]] = {
+    'and': all,
+    'or': any,
+    'sum': sum,
+    'min': min,
+    'max': max,
+}
+
+
+# ---------------------------------------------------------------------------
+# hub (runs on the primary host)
+
+
+class Hub:
+    """Star-topology router for the control plane.
+
+    Pure router: every host (the primary included) attaches a
+    :class:`TcpTransport` client, so client logic is rank-uniform. The hub
+    forwards ``event`` frames to every *other* client, completes collective
+    ops (``reduce``/``gather``/``barrier``) once all ranks contribute, and
+    monitors heartbeats.
+    """
+
+    def __init__(self, size: int, host: str = '127.0.0.1', port: int = 0,
+                 heartbeat_timeout: float | None = None):
+        self.size = size
+        self.heartbeat_timeout = heartbeat_timeout
+        self._server = socket.create_server((host, port))
+        self.address = self._server.getsockname()
+        self._clients: dict[int, socket.socket] = {}
+        self._locks = threading.Lock()
+        self._pending: dict[tuple, list] = {}
+        self._last_seen: dict[int, float] = {}
+        self._lost: set[int] = set()
+        self._closed = threading.Event()
+        self._threads = [threading.Thread(target=self._accept_loop, daemon=True)]
+        if heartbeat_timeout:
+            self._threads.append(
+                threading.Thread(target=self._monitor_loop, daemon=True))
+        for thread in self._threads:
+            thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                return
+            try:
+                frame = _recv_frame(sock)
+            except OSError:
+                continue
+            if not frame or frame[0] != 'hello':
+                sock.close()
+                continue
+            rank = frame[1]
+            with self._locks:
+                self._clients[rank] = sock
+                self._last_seen[rank] = time.monotonic()
+                self._lost.discard(rank)     # a rejoining worker is alive
+            self._fanout(('joined', rank), exclude=rank)
+            threading.Thread(target=self._client_loop, args=(rank, sock),
+                             daemon=True).start()
+
+    def _client_loop(self, rank: int, sock: socket.socket) -> None:
+        while not self._closed.is_set():
+            try:
+                frame = _recv_frame(sock)
+            except OSError:
+                frame = None
+            if frame is None or frame[0] == 'bye':
+                # 'bye' is a graceful detach; a dead connection (None) means
+                # the worker crashed — report it as lost immediately rather
+                # than waiting for the heartbeat monitor (which could never
+                # fire: the rank leaves the liveness table here).
+                with self._locks:
+                    self._clients.pop(rank, None)
+                    last_seen = self._last_seen.pop(rank, time.monotonic())
+                    crashed = (frame is None and rank not in self._lost
+                               and not self._closed.is_set())
+                    if crashed:
+                        self._lost.add(rank)
+                sock.close()
+                if crashed:
+                    self._fanout(('lost', rank, last_seen))
+                return
+            with self._locks:
+                self._last_seen[rank] = time.monotonic()
+                self._lost.discard(rank)     # any frame proves recovery
+            kind = frame[0]
+            if kind == 'hb':
+                continue
+            if kind == 'event':
+                self._fanout(frame, exclude=rank)
+            elif kind in ('reduce', 'gather'):
+                _, op_key, value = frame
+                with self._locks:
+                    values = self._pending.setdefault(op_key, [])
+                    values.append(value)
+                    done = len(values) >= self.size
+                    if done:
+                        del self._pending[op_key]
+                if done:
+                    kind_name, op, _ = op_key
+                    result = (_REDUCERS[op](values) if kind_name == 'reduce'
+                              else values)
+                    self._fanout(('result', op_key, result))
+
+    def _monitor_loop(self) -> None:
+        while not self._closed.wait(self.heartbeat_timeout / 4):
+            now = time.monotonic()
+            with self._locks:
+                stale = [(rank, seen) for rank, seen in self._last_seen.items()
+                         if now - seen > self.heartbeat_timeout
+                         and rank not in self._lost]
+                self._lost.update(rank for rank, _ in stale)
+            for rank, seen in stale:
+                self._fanout(('lost', rank, seen))
+
+    def _fanout(self, frame: tuple, exclude: int | None = None) -> None:
+        with self._locks:
+            targets = [sock for rank, sock in self._clients.items()
+                       if rank != exclude]
+        for sock in targets:
+            try:
+                _send_frame(sock, frame)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed.set()
+        self._server.close()
+        with self._locks:
+            for sock in self._clients.values():
+                sock.close()
+            self._clients.clear()
+
+
+# ---------------------------------------------------------------------------
+# transports
+
+
+class Loopback:
+    """Single-process control plane: collectives are identities, nothing is
+    forwarded. Keeps one code path from laptop to pod."""
+
+    rank = 0
+    size = 1
+
+    def __init__(self) -> None:
+        self._channels: dict[str, Callable[[Any], None]] = {}
+        self.on_control: Callable[[tuple], None] | None = None
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        """Register the receiver for one named event channel (each bus owns
+        its own channel, so several buses share one transport)."""
+        self._channels[channel] = callback
+
+    def send_event(self, channel: str, message: Any) -> None:
+        pass
+
+    def allreduce(self, value: Any, op: str = 'and') -> Any:
+        return _REDUCERS[op]([value])
+
+    def gather(self, value: Any) -> list:
+        return [value]
+
+    def barrier(self) -> None:
+        pass
+
+    def heartbeat(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class TcpTransport:
+    """Per-host client of the :class:`Hub`.
+
+    ``send_event`` forwards a pickled message to every other host (delivered
+    to their ``on_event``); ``allreduce``/``gather``/``barrier`` are
+    collective over all hosts and must be called in the same order on every
+    rank (SPMD control flow — the same discipline XLA collectives require).
+    """
+
+    def __init__(self, address: tuple, rank: int, size: int,
+                 heartbeat_interval: float | None = None,
+                 connect_timeout: float = 60.0):
+        self.rank = rank
+        self.size = size
+        self._channels: dict[str, Callable[[Any], None]] = {}
+        self.on_control: Callable[[tuple], None] | None = None
+        # Hosts of a pod start concurrently; the hub may not be listening
+        # yet when a non-primary dials in — bounded retry with backoff.
+        deadline = time.monotonic() + connect_timeout
+        delay = 0.05
+        while True:
+            try:
+                self._sock = socket.create_connection(address, timeout=5.0)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._results: dict[tuple, queue.Queue] = {}
+        self._results_lock = threading.Lock()
+        self._counter = itertools.count()
+        self._closed = threading.Event()
+        self._send(('hello', rank))
+        self._threads = [threading.Thread(target=self._recv_loop, daemon=True)]
+        if heartbeat_interval:
+            self._threads.append(threading.Thread(
+                target=self._heartbeat_loop, args=(heartbeat_interval,),
+                daemon=True))
+        for thread in self._threads:
+            thread.start()
+
+    def _send(self, frame: tuple) -> None:
+        with self._send_lock:
+            _send_frame(self._sock, frame)
+
+    def _recv_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                frame = _recv_frame(self._sock)
+            except OSError:
+                return
+            if frame is None:
+                return
+            kind = frame[0]
+            if kind == 'event':
+                callback = self._channels.get(frame[1])
+                if callback is not None:
+                    callback(frame[2])
+            elif kind == 'result':
+                _, op_key, result = frame
+                with self._results_lock:
+                    box = self._results.setdefault(op_key, queue.Queue())
+                box.put(result)
+            elif kind in ('lost', 'joined'):
+                if self.on_control is not None:
+                    self.on_control(frame)
+
+    def _heartbeat_loop(self, interval: float) -> None:
+        while not self._closed.wait(interval):
+            try:
+                self._send(('hb',))
+            except OSError:
+                return
+
+    def _collective(self, kind: str, op: str, value: Any, timeout: float) -> Any:
+        # Same call order on every rank => the same per-kind sequence number
+        # identifies the same collective everywhere.
+        op_key = (kind, op, next(self._counter))
+        with self._results_lock:
+            box = self._results.setdefault(op_key, queue.Queue())
+        self._send((kind, op_key, value))
+        result = box.get(timeout=timeout)
+        with self._results_lock:
+            self._results.pop(op_key, None)
+        return result
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
+        """Register the receiver for one named event channel."""
+        self._channels[channel] = callback
+
+    def send_event(self, channel: str, message: Any) -> None:
+        self._send(('event', channel, message))
+
+    def allreduce(self, value: Any, op: str = 'and', timeout: float = 300.0) -> Any:
+        return self._collective('reduce', op, value, timeout)
+
+    def gather(self, value: Any, timeout: float = 300.0) -> list:
+        return self._collective('gather', 'sum', value, timeout)
+
+    def barrier(self, timeout: float = 300.0) -> None:
+        self._collective('reduce', 'and', True, timeout)
+
+    def heartbeat(self) -> None:
+        self._send(('hb',))
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._send(('bye',))
+        except OSError:
+            pass
+        self._sock.close()
+
+
+def connect(address: tuple, world: World,
+            heartbeat_interval: float | None = None,
+            heartbeat_timeout: float | None = None) -> tuple[TcpTransport, Hub | None]:
+    """Attach this host to the control plane; the primary also hosts the Hub.
+
+    Returns ``(transport, hub)`` — ``hub`` is None off-primary. Typical
+    wiring: primary calls with ``port`` fixed in ``address``; others connect
+    to it.
+    """
+    hub = None
+    if world.is_primary:
+        hub = Hub(world.process_count, host=address[0], port=address[1],
+                  heartbeat_timeout=heartbeat_timeout)
+        address = hub.address
+    transport = TcpTransport(address, world.process_index, world.process_count,
+                             heartbeat_interval=heartbeat_interval)
+    return transport, hub
+
+
+# ---------------------------------------------------------------------------
+# distributed buses
+
+
+class DistributedProducer(Producer):
+    """The in-process :class:`Producer`, extended across hosts.
+
+    - ``register(consumer, primary_only=True)`` — the consumer runs only on
+      rank 0 (storage, TensorBoard), all other ranks skip it silently.
+    - ``wire(EventType, ...)`` — instances of these types are forwarded to
+      every other host on dispatch. Unwired events stay host-local (the
+      default: most events are per-host observability).
+    - remote events arrive on a transport thread and are queued; call
+      :meth:`drain` at a safe point in the host loop (epoch boundary) to
+      dispatch them locally — keeps consumers single-threaded, matching the
+      reference's synchronous bus semantics.
+    """
+
+    CHANNEL = 'producer'
+
+    def __init__(self, transport: Loopback | TcpTransport | None = None):
+        super().__init__()
+        self.transport = transport or Loopback()
+        self.wired: tuple[type, ...] = ()
+        self._inbox: queue.SimpleQueue = queue.SimpleQueue()
+        self.transport.subscribe(self.CHANNEL, self._inbox.put)
+        previous = self.transport.on_control
+
+        def on_control(frame: tuple) -> None:
+            if frame[0] == 'lost':
+                self._inbox.put(WorkerLost(rank=frame[1], last_seen=frame[2]))
+            elif frame[0] == 'joined':
+                self._inbox.put(WorkerJoined(rank=frame[1]))
+            if previous is not None:
+                previous(frame)
+        self.transport.on_control = on_control
+
+    def register(self, *consumers: Consumer, primary_only: bool = False) -> None:
+        if primary_only and self.transport.rank != 0:
+            return
+        super().register(*consumers)
+
+    def wire(self, *event_types: type) -> None:
+        self.wired = tuple(dict.fromkeys(self.wired + event_types))
+
+    def dispatch(self, message: Any) -> None:
+        super().dispatch(message)
+        if isinstance(message, self.wired):
+            self.transport.send_event(self.CHANNEL, message)
+
+    def drain(self) -> int:
+        """Dispatch queued remote events on the caller's thread; returns the
+        number delivered. Call once per epoch/phase — never per step."""
+        delivered = 0
+        while True:
+            try:
+                message = self._inbox.get_nowait()
+            except queue.Empty:
+                return delivered
+            super().dispatch(message)
+            delivered += 1
+
+
+class DistributedPublisher(Publisher):
+    """Topic bus across hosts: wired topics forward ``(topic, message)``."""
+
+    CHANNEL = 'publisher'
+
+    def __init__(self, transport: Loopback | TcpTransport | None = None):
+        super().__init__()
+        self.transport = transport or Loopback()
+        self.wired: frozenset[str] = frozenset()
+        self._inbox: queue.SimpleQueue = queue.SimpleQueue()
+        self.transport.subscribe(self.CHANNEL, self._inbox.put)
+
+    def register(self, *subscribers: Subscriber, primary_only: bool = False) -> None:
+        if primary_only and self.transport.rank != 0:
+            return
+        super().register(*subscribers)
+
+    def wire(self, *topics: str) -> None:
+        self.wired = self.wired | frozenset(topics)
+
+    def publish(self, message: Any, topic: str) -> None:
+        super().publish(message, topic)
+        if topic in self.wired:
+            self.transport.send_event(self.CHANNEL, (topic, message))
+
+    def drain(self) -> int:
+        delivered = 0
+        while True:
+            try:
+                topic, message = self._inbox.get_nowait()
+            except queue.Empty:
+                return delivered
+            super().publish(message, topic)
+            delivered += 1
+
+
+# ---------------------------------------------------------------------------
+# agreement — the early-stop commit point
+
+
+def agree(transport: Loopback | TcpTransport, flag: bool, op: str = 'or') -> bool:
+    """Collectively agree a boolean across hosts.
+
+    Early stopping in the reference is an exception unwinding one process
+    (``torchsystem/domain/events.py:162-163``); on a pod every host must
+    reach the same verdict *before* the next collective or the job
+    deadlocks. Default ``op='or'``: any host wanting to stop stops all —
+    call at the epoch boundary::
+
+        stop = agree(transport, wants_stop)
+        if stop: break
+    """
+    return bool(transport.allreduce(bool(flag), op=op))

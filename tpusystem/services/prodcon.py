@@ -112,15 +112,23 @@ class Consumer:
 
 
 class Producer:
-    """Fans events out to every registered consumer, synchronously, in order."""
+    """Fans events out to every registered consumer, synchronously, in order.
+
+    ``taps`` observe every dispatched message before routing — the hook used
+    by :class:`tpusystem.observe.EventLedger` to hash-chain the event stream
+    for cross-host divergence detection.
+    """
 
     def __init__(self) -> None:
         self.consumers: list[Consumer] = []
+        self.taps: list[Callable[[Any], None]] = []
 
     def register(self, *consumers: Consumer) -> None:
         self.consumers.extend(consumers)
 
     def dispatch(self, message: Any) -> None:
+        for tap in self.taps:
+            tap(message)
         for consumer in self.consumers:
             consumer.consume(message)
 
