@@ -1,0 +1,78 @@
+"""Runtime facade: single-process degenerate case and housekeeping."""
+
+from __future__ import annotations
+
+import pytest
+
+from tpusystem import Runtime
+from tpusystem.observe.events import Trained
+from tpusystem.parallel.multihost import Loopback
+from tpusystem.services.prodcon import Consumer
+
+
+class Model:
+    id = 'model-id'
+    epoch = 0
+
+
+class TestControlAddress:
+    def test_env_var_wins(self, monkeypatch):
+        from tpusystem.runtime import _control_address
+        monkeypatch.setenv('TPUSYSTEM_CONTROL', '10.0.0.5:9000')
+        assert _control_address('other:1234', None) == ('10.0.0.5', 9000)
+
+    def test_coordinator_port_plus_one(self, monkeypatch):
+        from tpusystem.runtime import _control_address
+        monkeypatch.delenv('TPUSYSTEM_CONTROL', raising=False)
+        assert _control_address('head:8476', None) == ('head', 8477)
+        assert _control_address('head:8476', 7000) == ('head', 7000)
+        assert _control_address('head', 7000) == ('head', 7000)
+
+    def test_no_address_is_an_error_not_localhost(self, monkeypatch):
+        from tpusystem.runtime import _control_address
+        monkeypatch.delenv('TPUSYSTEM_CONTROL', raising=False)
+        with pytest.raises(ValueError, match='control-plane address'):
+            _control_address(None, None)
+        with pytest.raises(ValueError, match='control-plane address'):
+            _control_address('head-no-port', None)
+
+
+def test_single_process_runtime_is_loopback():
+    with Runtime() as runtime:
+        assert runtime.world.process_count == 1
+        assert runtime.is_primary
+        assert isinstance(runtime.transport, Loopback)
+        assert runtime.hub is None
+
+
+def test_primary_only_consumers_run_on_rank0():
+    with Runtime() as runtime:
+        seen = []
+        consumer = Consumer()
+        consumer.register(Trained, seen.append)
+        runtime.producer.register(consumer, primary_only=True)
+        runtime.producer.dispatch(Trained(model=Model(), metrics={'loss': 0.1}))
+        assert len(seen) == 1
+
+
+def test_sync_and_stop_housekeeping():
+    with Runtime(ledger=True) as runtime:
+        runtime.producer.dispatch(Trained(model=Model(), metrics={}))
+        runtime.sync()                       # drains + verifies ledger
+        assert runtime.ledger.count == 1
+        assert runtime.should_stop(False) is False
+        assert runtime.should_stop(True) is True
+        runtime.barrier()
+
+
+def test_epoch_loop_pattern_with_early_stop():
+    """The docstring's pod-ready loop, end to end on Loopback."""
+    with Runtime() as runtime:
+        stopped_at = None
+        for epoch in range(10):
+            wants_stop = epoch >= 3          # stand-in for a stop event
+            runtime.sync()
+            if runtime.should_stop(wants_stop):
+                stopped_at = epoch
+                break
+        assert stopped_at == 3

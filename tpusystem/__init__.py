@@ -10,7 +10,9 @@ control-plane bus that spans multi-host TPU pods.
 from tpusystem.compiler import Compiler
 from tpusystem.depends import Depends, Provider
 from tpusystem.domain import Aggregate, Event, Events
+from tpusystem.runtime import Runtime
 
 __version__ = '0.1.0'
 
-__all__ = ['Aggregate', 'Compiler', 'Depends', 'Provider', 'Event', 'Events']
+__all__ = ['Aggregate', 'Compiler', 'Depends', 'Provider', 'Event', 'Events',
+           'Runtime']

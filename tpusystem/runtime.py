@@ -1,0 +1,153 @@
+"""Per-host runtime: world, control plane, and buses in one facade.
+
+The reference's composition root is a single-process ``main.py``
+(``examples/tinysys/main.py``); a TPU pod runs that composition root once
+per host. :class:`Runtime` is the object that makes the same ``main()``
+correct in both worlds:
+
+* joins the multi-host job (``jax.distributed``-style) when a coordinator
+  is configured, stays single-process otherwise;
+* brings up the control plane (:mod:`tpusystem.parallel.multihost`) — the
+  primary host doubles as the :class:`~tpusystem.parallel.multihost.Hub`;
+* exposes :class:`~tpusystem.parallel.multihost.DistributedProducer` /
+  ``DistributedPublisher`` buses with rank-aware consumer placement, so
+  storage/TensorBoard consumers register ``primary_only`` and run exactly
+  once per experiment (SURVEY.md §5);
+* optionally hash-chains the event stream
+  (:class:`~tpusystem.observe.EventLedger`) for cross-host divergence
+  detection;
+* owns the epoch-boundary housekeeping — :meth:`sync` drains remote
+  events and verifies the ledger; :meth:`should_stop` turns one host's
+  stop wish into everyone's verdict before the next collective.
+
+Typical pod-ready epoch loop::
+
+    runtime = Runtime()                       # env-driven; Loopback off-pod
+    runtime.producer.register(logging_consumer())
+    runtime.producer.register(tracking_consumer(), primary_only=True)
+    for epoch in range(epochs):
+        try:
+            service.handle('iterate', model, loaders, metrics)
+            wants_stop = False
+        except StopIteration:      # unhandled stop event unwound from commit
+            wants_stop = True
+        runtime.sync()
+        if runtime.should_stop(wants_stop):
+            break
+    runtime.close()
+"""
+
+from __future__ import annotations
+
+import os
+
+from tpusystem.observe.ledger import EventLedger
+from tpusystem.parallel import multihost
+from tpusystem.parallel.multihost import (
+    DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
+    World,
+)
+
+
+def _control_address(coordinator: str | None,
+                     control_port: int | None) -> tuple[str, int]:
+    """Resolve where the control-plane hub lives for a multi-host job.
+
+    Precedence: ``TPUSYSTEM_CONTROL=host:port`` env var; else the
+    coordinator's host with ``control_port`` (or the coordinator port + 1).
+    There is deliberately no localhost fallback — every host dialing its own
+    loopback would "work" single-host and silently partition a pod.
+    """
+    spec = os.environ.get('TPUSYSTEM_CONTROL')
+    if spec:
+        host, separator, port = spec.rpartition(':')
+        if not separator:
+            raise ValueError(f'TPUSYSTEM_CONTROL must be host:port, got {spec!r}')
+        return host, int(port)
+    if coordinator:
+        host, separator, port = coordinator.rpartition(':')
+        if not separator:
+            host, port = coordinator, None
+        if control_port is not None:
+            return host, control_port
+        if port is not None:
+            return host, int(port) + 1
+    raise ValueError(
+        'multi-host job without a control-plane address: set '
+        'TPUSYSTEM_CONTROL=host:port, or pass coordinator="host:port" '
+        '(control plane defaults to port+1)')
+
+
+class Runtime:
+    """Host-side runtime context for a (possibly multi-host) training job.
+
+    Args:
+        coordinator: ``host:port`` of the JAX coordinator, or None to read
+            ``TPUSYSTEM_COORDINATOR`` from the environment; absent both, the
+            job is single-process and the control plane is a
+            :class:`Loopback`.
+        control_port: TCP port for the control-plane hub on the primary
+            host (default: coordinator port + 1).
+        ledger: hash-chain the event stream for divergence detection
+            (:meth:`sync` then verifies it across hosts).
+        heartbeat: seconds between liveness pings; a host silent for 4
+            intervals surfaces as a ``WorkerLost`` event on every other
+            host. ``None`` disables failure detection.
+    """
+
+    def __init__(self, coordinator: str | None = None, *,
+                 control_port: int | None = None,
+                 num_processes: int | None = None,
+                 process_id: int | None = None,
+                 ledger: bool = False,
+                 heartbeat: float | None = 10.0) -> None:
+        coordinator = coordinator or os.environ.get('TPUSYSTEM_COORDINATOR')
+        self.world: World = multihost.initialize(
+            coordinator, num_processes, process_id)
+        self.hub: Hub | None = None
+        if self.world.process_count > 1:
+            address = _control_address(coordinator, control_port)
+            self.transport, self.hub = multihost.connect(
+                address, self.world,
+                heartbeat_interval=heartbeat,
+                heartbeat_timeout=4 * heartbeat if heartbeat else None)
+        else:
+            self.transport: Loopback | TcpTransport = Loopback()
+        self.producer = DistributedProducer(self.transport)
+        self.publisher = DistributedPublisher(self.transport)
+        self.ledger: EventLedger | None = (
+            EventLedger().tap(self.producer) if ledger else None)
+
+    @property
+    def is_primary(self) -> bool:
+        return self.world.is_primary
+
+    def sync(self) -> None:
+        """Epoch-boundary housekeeping: deliver queued remote events on this
+        thread, then (when enabled) verify the event hash-chain across
+        hosts. Call once per epoch — never per step."""
+        self.producer.drain()
+        self.publisher.drain()
+        if self.ledger is not None:
+            self.ledger.verify(self.transport)
+
+    def should_stop(self, wants_stop: bool) -> bool:
+        """Collective early-stop verdict: any host wanting out stops all
+        (the distributed form of the reference's exception-unwinding stop,
+        ``torchsystem/domain/events.py:162-163``)."""
+        return multihost.agree(self.transport, wants_stop, op='or')
+
+    def barrier(self) -> None:
+        """Host-level rendezvous (checkpoint commit points etc.)."""
+        self.transport.barrier()
+
+    def close(self) -> None:
+        self.transport.close()
+        if self.hub is not None:
+            self.hub.close()
+
+    def __enter__(self) -> 'Runtime':
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.close()
