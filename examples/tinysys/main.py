@@ -1,0 +1,97 @@
+"""tinysys composition root.
+
+Reference parity: ``examples/tinysys/main.py`` — register types, override
+dependencies, wire producer->consumers, build parts, compile the aggregate,
+drive epochs. The same file is pod-ready: :class:`tpusystem.Runtime` brings
+up the control plane (a no-op Loopback when single-process), storage and
+TensorBoard consumers register ``primary_only``, and the early-stop verdict
+is collectively agreed each epoch.
+
+Run: ``python main.py [epochs]`` from this directory.
+"""
+
+from __future__ import annotations
+
+import logging
+import pathlib
+import sys
+
+from tpusystem import Runtime
+from tpusystem.checkpoint import Repository
+from tpusystem.data import Loader, SyntheticDigits
+from tpusystem.models import MLP
+from tpusystem.observe import (logging_consumer, tensorboard_consumer,
+                               tracking_consumer)
+from tpusystem.observe import tensorboard as tb
+from tpusystem.observe import tracking
+from tpusystem.storage import (DocumentIterations, DocumentMetrics,
+                               DocumentModels, DocumentModules, DocumentStore)
+from tpusystem.train import Adam, CrossEntropyLoss
+
+from tinysys.metrics import ClassifierMetrics
+from tinysys.services import compilation, training
+
+ROOT = pathlib.Path(__file__).parent / 'data'
+
+
+def main(epochs: int = 10) -> None:
+    logging.basicConfig(level=logging.INFO, format='%(message)s', force=True)
+    for noisy in ('orbax', 'absl', 'jax'):
+        logging.getLogger(noisy).setLevel(logging.WARNING)
+    runtime = Runtime(ledger=True)
+
+    # --- storage + observability wiring (primary host only) ---------------
+    store = DocumentStore(ROOT / 'experiments.json')
+    repository = Repository(ROOT / 'weights')
+    overrides = {
+        tracking.metrics_store: lambda: DocumentMetrics(store),
+        tracking.models_store: lambda: DocumentModels(store),
+        tracking.modules_store: lambda: DocumentModules(store),
+        tracking.iterations_store: lambda: DocumentIterations(store),
+        tracking.repository: lambda: repository,
+        tb.writer: lambda: tb.SummaryWriter(ROOT / 'tensorboard'),
+    }
+    for consumer in (tracking_consumer(), tensorboard_consumer()):
+        consumer.dependency_overrides.update(overrides)
+        runtime.producer.register(consumer, primary_only=True)
+    runtime.producer.register(logging_consumer())
+    training.producer = runtime.producer   # handlers dispatch on the runtime bus
+
+    # --- compilation pipeline overrides -----------------------------------
+    compilation.provider.override(compilation.models, lambda: DocumentModels(store))
+    compilation.provider.override(compilation.repository, lambda: repository)
+
+    # --- build + compile the aggregate ------------------------------------
+    network = MLP(features=(256, 128), classes=10, dropout=0.1)
+    classifier = compilation.compiler.compile(
+        network, CrossEntropyLoss(), Adam(lr=1e-3))
+
+    loaders = {
+        'train': Loader(SyntheticDigits(samples=4096), batch_size=64,
+                        shuffle=True, seed=1),
+        'evaluation': Loader(SyntheticDigits(samples=1024, train=False),
+                             batch_size=64),
+    }
+    metrics = ClassifierMetrics()
+
+    # --- epoch loop, pod-correct early stop -------------------------------
+    print(f'training {classifier.id} from epoch {classifier.epoch}')
+    try:
+        for _ in range(classifier.epoch, epochs):
+            wants_stop = False
+            try:
+                training.service.handle('iterate', classifier, loaders, metrics)
+            except StopIteration:
+                wants_stop = True
+            runtime.sync()
+            if runtime.should_stop(wants_stop):
+                print('early stop agreed across hosts')
+                break
+    finally:
+        repository.wait()
+        store.close()
+        runtime.close()
+
+
+if __name__ == '__main__':
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 10)

@@ -1,0 +1,9 @@
+"""tinysys — the reference application, TPU-native.
+
+The end-to-end training system the reference ships as its flagship example
+(``/root/reference/examples/tinysys``): a classifier aggregate built by a
+compiler pipeline, driven by a named service, observed by decoupled
+consumers (logging, experiment tracking, TensorBoard), with identity-keyed
+checkpoint/resume. Here the classifier trains on a TPU mesh through jitted,
+donated step functions; everything else is the same architecture.
+"""

@@ -1,0 +1,76 @@
+"""The classifier aggregate.
+
+Reference parity: ``examples/tinysys/tinysys/classifier.py`` — an aggregate
+whose identity is the hash of its network and whose ``fit``/``evaluate``
+are the per-step hot path. TPU-native split: the host side (this class)
+carries identity, phase and epoch; the math is two jitted step functions
+advancing an immutable :class:`~tpusystem.train.TrainState` that lives
+sharded on the mesh. ``fit`` returns device values only — metrics
+accumulate on device and the single host sync happens once per phase.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from tpusystem import Aggregate
+from tpusystem.parallel import batch_sharding, replicated
+from tpusystem.registry import gethash
+from tpusystem.train import (build_eval_step, build_train_step, flax_apply,
+                             init_state)
+
+
+class Classifier(Aggregate):
+    """Network + criterion + optimizer as one identity-bearing unit."""
+
+    def __init__(self, network, criterion, optimizer):
+        super().__init__()
+        self.network = network
+        self.criterion = criterion
+        self.optimizer = optimizer
+        self.state = None           # TrainState; created by place()
+        self.mesh = None
+        self.epoch = 0              # first assignment: no onepoch() fire
+        apply_fn = flax_apply(network)
+        self._train_step = build_train_step(apply_fn, criterion, optimizer)
+        self._eval_step = build_eval_step(apply_fn, criterion)
+
+    @property
+    def id(self) -> str:
+        """Registry hash of the network — deterministic across hosts and
+        restarts (``examples/tinysys/tinysys/classifier.py:18-20``)."""
+        return gethash(self.network)
+
+    def modules(self) -> dict[str, Any]:
+        """Registered parts, for the experiment-tracking consumer."""
+        return {'nn': self.network, 'criterion': self.criterion,
+                'optimizer': self.optimizer}
+
+    def place(self, sample_inputs, mesh) -> None:
+        """Initialize device state on the mesh: parameters replicated (small
+        model), batches sharded over the data axes."""
+        self.mesh = mesh
+        state = init_state(self.network, self.optimizer, sample_inputs)
+        self.state = jax.device_put(state, replicated(mesh))
+
+    def shard_batch(self, batch: tuple) -> tuple:
+        return tuple(jax.device_put(part, batch_sharding(self.mesh))
+                     for part in batch)
+
+    def fit(self, inputs, targets):
+        """One optimization step; returns (predictions, loss) on device."""
+        self.state, (outputs, loss) = self._train_step(self.state, inputs, targets)
+        return jnp.argmax(outputs, -1), loss
+
+    def evaluate(self, inputs, targets):
+        """Deterministic forward; returns (predictions, loss) on device."""
+        outputs, loss = self._eval_step(self.state, inputs, targets)
+        return jnp.argmax(outputs, -1), loss
+
+    def onepoch(self) -> None:
+        """Commit domain events at every epoch edge — enqueued exceptions
+        (early stop) unwind into the epoch loop here."""
+        self.events.commit()

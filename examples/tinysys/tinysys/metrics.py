@@ -1,0 +1,28 @@
+"""Classification metric bundle.
+
+Reference parity: ``examples/tinysys/tinysys/metrics.py`` (torcheval Mean +
+MulticlassAccuracy, on device). Accumulation is on-device per batch; the
+one ``jax.device_get`` per phase happens in :meth:`compute`.
+"""
+
+from __future__ import annotations
+
+from tpusystem.train import Accuracy, Mean
+
+
+class ClassifierMetrics:
+    def __init__(self) -> None:
+        self.loss = Mean()
+        self.accuracy = Accuracy()
+
+    def update(self, loss, predictions, targets) -> None:
+        self.loss.update(loss)
+        self.accuracy.update(predictions, targets)
+
+    def compute(self) -> dict[str, float]:
+        return {'loss': self.loss.compute(),
+                'accuracy': self.accuracy.compute()}
+
+    def reset(self) -> None:
+        self.loss.reset()
+        self.accuracy.reset()

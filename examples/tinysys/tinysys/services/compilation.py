@@ -1,0 +1,95 @@
+"""Aggregate compilation pipeline.
+
+Reference parity: ``examples/tinysys/tinysys/services/compilation.py`` —
+build -> move to device -> compile -> bring epoch -> restore weights. The
+TPU lowering of each stage: construction is pure host Python; "move to
+device" places the state pytree on the injected *mesh* with its shardings;
+"compile" warms the jitted steps (XLA lowering is cached, so first-batch
+latency moves here); create-or-resume reads the experiment store by the
+aggregate's identity hash and refuses epoch regressions; restore loads the
+sharded checkpoint onto the current mesh.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from tpusystem.compiler import Compiler, Depends
+from tpusystem.depends import Provider
+from tpusystem.parallel import single_device_mesh
+from tpusystem.storage import ports
+
+from ..classifier import Classifier
+
+provider = Provider()
+compiler = Compiler[Classifier](provider=provider)
+
+
+def mesh():
+    """The device mesh (override at the composition root for pods)."""
+    return single_device_mesh()
+
+
+def sample_inputs():
+    """A shape-defining sample batch for parameter initialization."""
+    return jnp.zeros((1, 28, 28), jnp.float32)
+
+
+def models() -> ports.Models:
+    raise NotImplementedError('override the models store dependency')
+
+
+def experiment() -> str:
+    return 'default'
+
+
+def repository():
+    raise NotImplementedError('override the repository dependency')
+
+
+@compiler.step
+def build_classifier(network, criterion, optimizer) -> Classifier:
+    return Classifier(network, criterion, optimizer)
+
+
+@compiler.step
+def place_on_mesh(classifier: Classifier,
+                  device_mesh=Depends(mesh),
+                  sample=Depends(sample_inputs)) -> Classifier:
+    classifier.place(sample, device_mesh)
+    return classifier
+
+
+@compiler.step
+def warm_compile(classifier: Classifier,
+                 sample=Depends(sample_inputs)) -> Classifier:
+    """Trigger XLA lowering now (traces are cached by shape): the analogue
+    of the reference's ``torch.compile`` stage."""
+    targets = jnp.zeros((sample.shape[0],), jnp.int32)
+    classifier._eval_step(classifier.state, sample, targets)
+    return classifier
+
+
+@compiler.step
+def bring_epoch(classifier: Classifier,
+                store: ports.Models = Depends(models),
+                name: str = Depends(experiment)) -> Classifier:
+    """Create-or-resume by identity (``compilation.py:41-57``): an existing
+    row resumes at its recorded epoch; a fresh aggregate gets a row at 0."""
+    row = store.read(str(classifier.id), name)
+    if row is None:
+        store.create(ports.Model(hash=str(classifier.id), experiment=name, epoch=0))
+        return classifier
+    if row.epoch < classifier.epoch:
+        raise ValueError(
+            f'epoch regression: store has {row.epoch}, aggregate at {classifier.epoch}')
+    classifier.epoch = row.epoch
+    return classifier
+
+
+@compiler.step
+def restore_weights(classifier: Classifier,
+                    weights=Depends(repository)) -> Classifier:
+    if classifier.epoch > 0:
+        weights.restore(classifier)
+    return classifier

@@ -1,0 +1,59 @@
+"""Training service: iterate / train / validate.
+
+Reference parity: ``examples/tinysys/tinysys/services/training.py`` — the
+epoch choreography as named, DI-injected handlers, with one event per phase
+on the producer. TPU difference: the hot loop advances a jitted step and
+touches no host values; throughput timing brackets the whole phase
+(:class:`tpusystem.observe.StepTimer`), and batches land pre-sharded via
+the aggregate's ``shard_batch``.
+"""
+
+from __future__ import annotations
+
+from tpusystem.depends import Provider
+from tpusystem.observe import StepTimer
+from tpusystem.observe.events import Iterated, Trained, Validated
+from tpusystem.services import Producer, Service
+
+provider = Provider()
+service = Service(provider=provider)
+producer = Producer()
+
+
+@service.handler
+def iterate(model, loaders, metrics) -> None:
+    """One epoch: train phase, validation phase, epoch edge + event."""
+    train(model, loaders['train'], metrics)
+    metrics.reset()
+    validate(model, loaders['evaluation'], metrics)
+    metrics.reset()
+    model.epoch += 1                      # fires onepoch() -> events.commit()
+    producer.dispatch(Iterated(model, loaders))
+
+
+@service.handler
+def train(model, loader, metrics) -> None:
+    model.phase = 'train'
+    timer = StepTimer(producer).start()
+    loss = None
+    for batch in loader:
+        inputs, targets = model.shard_batch(batch)
+        predictions, loss = model.fit(inputs, targets)
+        metrics.update(loss, predictions, targets)
+    results = metrics.compute()           # the one device->host sync
+    timer.stop(model, 'train', steps=len(loader), result=loss)
+    producer.dispatch(Trained(model, results))
+
+
+@service.handler
+def validate(model, loader, metrics) -> None:
+    model.phase = 'evaluation'
+    timer = StepTimer(producer).start()
+    loss = None
+    for batch in loader:
+        inputs, targets = model.shard_batch(batch)
+        predictions, loss = model.evaluate(inputs, targets)
+        metrics.update(loss, predictions, targets)
+    results = metrics.compute()
+    timer.stop(model, 'evaluation', steps=len(loader), result=loss)
+    producer.dispatch(Validated(model, results))

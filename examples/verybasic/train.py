@@ -1,0 +1,33 @@
+"""Minimal end-to-end training — the framework without the architecture.
+
+One MLP, one jitted donated step, one loader; loss decreases on a single
+chip. This is the smallest possible tpusystem program (the reference's
+``examples/verybasic`` tier); ``examples/tinysys`` shows the full
+message-driven system on top of the same pieces.
+"""
+
+import jax.numpy as jnp
+
+from tpusystem.data import Loader, SyntheticDigits
+from tpusystem.models import MLP
+from tpusystem.train import (Adam, CrossEntropyLoss, Mean, build_train_step,
+                             flax_apply, init_state)
+
+
+def main() -> None:
+    module = MLP(features=(128,), classes=10)
+    optimizer = Adam(lr=1e-3)
+    step = build_train_step(flax_apply(module), CrossEntropyLoss(), optimizer)
+    state = init_state(module, optimizer, jnp.zeros((1, 28, 28)))
+
+    loader = Loader(SyntheticDigits(samples=2048), batch_size=64, shuffle=True)
+    for epoch in range(3):
+        loss = Mean()
+        for inputs, targets in loader:
+            state, (_, batch_loss) = step(state, inputs, targets)
+            loss.update(batch_loss)
+        print(f'epoch {epoch}: loss={loss.compute():.4f}')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,56 @@
+"""Integration test: the tinysys example app end to end, twice (resume).
+
+The reference's example is its real test of the architecture; here the
+whole composition root runs in-process — compiler pipeline, service
+handlers, event consumers, document storage, async checkpointing — then
+runs *again* to pin resume-by-identity (SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import pathlib
+import sys
+
+import pytest
+
+EXAMPLE = pathlib.Path(__file__).parent.parent / 'examples' / 'tinysys'
+
+
+@pytest.fixture()
+def tinysys_main(tmp_path, monkeypatch):
+    monkeypatch.syspath_prepend(str(EXAMPLE))
+    spec = importlib.util.spec_from_file_location('tinysys_main', EXAMPLE / 'main.py')
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    monkeypatch.setattr(module, 'ROOT', tmp_path)
+    return module
+
+
+def test_trains_tracks_and_resumes(tinysys_main, capsys):
+    tinysys_main.main(epochs=2)
+    out = capsys.readouterr().out
+    assert 'from epoch 0' in out
+
+    store_path = tinysys_main.ROOT / 'experiments.json'
+    assert store_path.exists()
+
+    from tpusystem.storage import (DocumentMetrics, DocumentModels,
+                                   DocumentStore)
+    store = DocumentStore(store_path)
+    models = DocumentModels(store).list('default')
+    assert len(models) == 1 and models[0].epoch == 2
+    rows = DocumentMetrics(store).list(models[0].hash)
+    assert {row.name for row in rows} == {'loss', 'accuracy'}
+    assert any(row.phase == 'evaluation' for row in rows)
+
+    checkpoints = list((tinysys_main.ROOT / 'weights').iterdir())
+    assert len(checkpoints) == 1  # one identity directory
+
+    # --- second run resumes at the stored epoch, trains the remainder -----
+    tinysys_main.main(epochs=3)
+    out = capsys.readouterr().out
+    assert 'from epoch 2' in out
+    store = DocumentStore(store_path)
+    models = DocumentModels(store).list('default')
+    assert models[0].epoch == 3

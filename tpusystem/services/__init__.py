@@ -1,5 +1,7 @@
+from tpusystem.depends import Depends
 from tpusystem.services.service import Service
 from tpusystem.services.prodcon import Consumer, Producer, event
 from tpusystem.services.pubsub import Publisher, Subscriber
 
-__all__ = ['Service', 'Consumer', 'Producer', 'event', 'Publisher', 'Subscriber']
+__all__ = ['Service', 'Consumer', 'Producer', 'event', 'Publisher',
+           'Subscriber', 'Depends']
