@@ -9,6 +9,7 @@ stop decisions are collectively agreed, and a silent host surfaces as a
 
 from __future__ import annotations
 
+import socket
 import time
 
 import pytest
@@ -286,7 +287,12 @@ class TestFailureDetection:
             consumer = Consumer()
             consumer.register(WorkerLost, lost.append)
             producer.register(consumer)
-            transports[1]._sock.close()          # crash: socket dies, no bye
+            # Crash: the connection dies with no 'bye'. shutdown() (not just
+            # close()) is needed in-process: the transport's own recv thread
+            # keeps the open file description alive, so a bare close() never
+            # sends the FIN a real process death would.
+            transports[1]._sock.shutdown(socket.SHUT_RDWR)
+            transports[1]._sock.close()
             assert wait_until(lambda: not producer._inbox.empty())
             producer.drain()
             assert lost and lost[0].rank == 1
