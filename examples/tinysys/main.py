@@ -12,6 +12,7 @@ Run: ``python main.py [epochs]`` from this directory.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import pathlib
 import sys
@@ -20,8 +21,9 @@ from tpusystem import Runtime
 from tpusystem.checkpoint import Repository
 from tpusystem.data import Loader, SyntheticDigits
 from tpusystem.models import MLP
-from tpusystem.observe import (logging_consumer, tensorboard_consumer,
-                               tracking_consumer)
+from tpusystem.observe import (checkpoint_consumer, logging_consumer,
+                               tensorboard_consumer, tracking_consumer)
+from tpusystem.parallel import MeshSpec
 from tpusystem.observe import tensorboard as tb
 from tpusystem.observe import tracking
 from tpusystem.storage import (DocumentIterations, DocumentMetrics,
@@ -32,6 +34,7 @@ from tinysys.metrics import ClassifierMetrics
 from tinysys.services import compilation, training
 
 ROOT = pathlib.Path(__file__).parent / 'data'
+BATCH = 64
 
 
 def main(epochs: int = 10) -> None:
@@ -54,12 +57,21 @@ def main(epochs: int = 10) -> None:
     for consumer in (tracking_consumer(), tensorboard_consumer()):
         consumer.dependency_overrides.update(overrides)
         runtime.producer.register(consumer, primary_only=True)
+    # Checkpoint saves are collective (each host writes its own shards), so
+    # this consumer runs on EVERY host, unlike the metadata stores above.
+    saver = checkpoint_consumer()
+    saver.dependency_overrides[tracking.repository] = lambda: repository
+    runtime.producer.register(saver)
     runtime.producer.register(logging_consumer())
     training.producer = runtime.producer   # handlers dispatch on the runtime bus
 
     # --- compilation pipeline overrides -----------------------------------
     compilation.provider.override(compilation.models, lambda: DocumentModels(store))
     compilation.provider.override(compilation.repository, lambda: repository)
+    # Data-parallel over every chip in the job (global mesh on a pod); the
+    # default is a single-device mesh, which would be wrong everywhere else.
+    compilation.provider.override(compilation.mesh, lambda: MeshSpec(data=-1).build())
+    compilation.provider.override(compilation.batch_size, lambda: BATCH)
 
     # --- build + compile the aggregate ------------------------------------
     network = MLP(features=(256, 128), classes=10, dropout=0.1)
@@ -67,10 +79,10 @@ def main(epochs: int = 10) -> None:
         network, CrossEntropyLoss(), Adam(lr=1e-3))
 
     loaders = {
-        'train': Loader(SyntheticDigits(samples=4096), batch_size=64,
+        'train': Loader(SyntheticDigits(samples=4096), batch_size=BATCH,
                         shuffle=True, seed=1),
         'evaluation': Loader(SyntheticDigits(samples=1024, train=False),
-                             batch_size=64),
+                             batch_size=BATCH),
     }
     metrics = ClassifierMetrics()
 
@@ -88,9 +100,13 @@ def main(epochs: int = 10) -> None:
                 print('early stop agreed across hosts')
                 break
     finally:
-        repository.wait()
-        store.close()
-        runtime.close()
+        # LIFO stack: each close runs even if an earlier one (or the async
+        # checkpoint wait) raises — a failed save must not leak the control
+        # plane or the document store.
+        with contextlib.ExitStack() as cleanup:
+            cleanup.callback(runtime.close)
+            cleanup.callback(store.close)
+            repository.close()   # waits for pending async saves, then releases
 
 
 if __name__ == '__main__':

@@ -12,6 +12,7 @@ sharded checkpoint onto the current mesh.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from tpusystem.compiler import Compiler, Depends
@@ -33,6 +34,13 @@ def mesh():
 def sample_inputs():
     """A shape-defining sample batch for parameter initialization."""
     return jnp.zeros((1, 28, 28), jnp.float32)
+
+
+def batch_size() -> int:
+    """The production batch size — jit caches are keyed by shape and
+    sharding, so warming with any other size compiles a trace that is never
+    reused (override to match the loaders at the composition root)."""
+    return 64
 
 
 def models() -> ports.Models:
@@ -62,11 +70,19 @@ def place_on_mesh(classifier: Classifier,
 
 @compiler.step
 def warm_compile(classifier: Classifier,
-                 sample=Depends(sample_inputs)) -> Classifier:
-    """Trigger XLA lowering now (traces are cached by shape): the analogue
-    of the reference's ``torch.compile`` stage."""
-    targets = jnp.zeros((sample.shape[0],), jnp.int32)
-    classifier._eval_step(classifier.state, sample, targets)
+                 sample=Depends(sample_inputs),
+                 size: int = Depends(batch_size)) -> Classifier:
+    """Trigger XLA lowering now: the analogue of the reference's
+    ``torch.compile`` stage. Both steps are traced with production-shaped,
+    production-sharded batches (jit caches key on shape *and* sharding);
+    the train step runs on a copy of the state because it donates its
+    buffers."""
+    inputs = jnp.zeros((size, *sample.shape[1:]), sample.dtype)
+    targets = jnp.zeros((size,), jnp.int32)
+    inputs, targets = classifier.shard_batch((inputs, targets))
+    classifier._eval_step(classifier.state, inputs, targets)
+    throwaway = jax.tree_util.tree_map(jnp.copy, classifier.state)
+    classifier._train_step(throwaway, inputs, targets)
     return classifier
 
 

@@ -27,8 +27,13 @@ def iterate(model, loaders, metrics) -> None:
     metrics.reset()
     validate(model, loaders['evaluation'], metrics)
     metrics.reset()
-    model.epoch += 1                      # fires onepoch() -> events.commit()
-    producer.dispatch(Iterated(model, loaders))
+    try:
+        model.epoch += 1                  # fires onepoch() -> events.commit()
+    finally:
+        # The epoch edge may unwind an early-stop exception; the Iterated
+        # event must still go out or the stopping epoch — the one most worth
+        # keeping — would never reach the store/checkpoint consumers.
+        producer.dispatch(Iterated(model, loaders))
 
 
 @service.handler

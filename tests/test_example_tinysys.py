@@ -54,3 +54,54 @@ def test_trains_tracks_and_resumes(tinysys_main, capsys):
     store = DocumentStore(store_path)
     models = DocumentModels(store).list('default')
     assert models[0].epoch == 3
+
+
+def test_early_stop_epoch_still_dispatches_iterated(monkeypatch):
+    """The epoch edge may unwind an early-stop exception; the Iterated event
+    (store-row advance + checkpoint) must go out regardless — the stopping
+    epoch is the one most worth keeping."""
+    import types
+    monkeypatch.syspath_prepend(str(EXAMPLE))
+    from tinysys.services import training
+    from tpusystem.observe.events import Iterated
+
+    class StopModel:
+        id = 'stop-model'
+
+        def __init__(self):
+            object.__setattr__(self, 'epoch', 0)
+            object.__setattr__(self, 'phase', None)
+
+        def shard_batch(self, batch):
+            return batch
+
+        def fit(self, inputs, targets):
+            return targets, 0.0
+
+        def evaluate(self, inputs, targets):
+            return targets, 0.0
+
+        def __setattr__(self, key, value):
+            object.__setattr__(self, key, value)
+            if key == 'epoch' and value > 0:
+                raise StopIteration   # the aggregate's commit() unwinding
+
+    class Metrics:
+        def update(self, *parts):
+            pass
+
+        def compute(self):
+            return {}
+
+        def reset(self):
+            pass
+
+    events = []
+    monkeypatch.setattr(training, 'producer',
+                        types.SimpleNamespace(dispatch=events.append))
+    model = StopModel()
+    loaders = {'train': [((0,), (0,))], 'evaluation': [((0,), (0,))]}
+    with pytest.raises(StopIteration):
+        training.iterate(model, loaders, Metrics())
+    assert model.epoch == 1
+    assert any(isinstance(event, Iterated) for event in events)

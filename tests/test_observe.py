@@ -14,7 +14,8 @@ import pytest
 from tpusystem.checkpoint import Repository
 from tpusystem.observe import (
     Iterated, StepTimed, SummaryWriter, Trained, Validated,
-    logging_consumer, tensorboard_consumer, tracking_consumer,
+    checkpoint_consumer, logging_consumer, tensorboard_consumer,
+    tracking_consumer,
 )
 from tpusystem.observe import tensorboard as tensorboard_module
 from tpusystem.observe import tracking
@@ -140,6 +141,7 @@ def test_tensorboard_consumer_charts_per_phase(tmp_path):
 def tracked(tmp_path):
     store = DocumentStore(tmp_path / 'db.json')
     consumer = tracking_consumer()
+    saver = checkpoint_consumer()
     fixtures = {
         'metrics': DocumentMetrics(store),
         'models': DocumentModels(store),
@@ -154,12 +156,13 @@ def tracked(tmp_path):
     overrides[tracking.iterations_store] = lambda: fixtures['iterations']
     overrides[tracking.repository] = lambda: fixtures['repository']
     overrides[tracking.experiment] = lambda: 'exp-test'
-    yield consumer, fixtures
+    saver.dependency_overrides[tracking.repository] = lambda: fixtures['repository']
+    yield (consumer, saver), fixtures
     fixtures['repository'].close()
 
 
 def test_tracking_consumer_persists_metrics_and_epoch(tracked):
-    consumer, fixtures = tracked
+    (consumer, _), fixtures = tracked
     model = Model(identity='m1', epoch=4)
     consumer.consume(Trained(model, {'loss': 0.33}))
     consumer.consume(Validated(model, {'loss': 0.44, 'accuracy': 0.9}))
@@ -176,12 +179,14 @@ def test_tracking_consumer_persists_module_metadata_and_weights(tracked):
     from tpusystem.models import MLP
     from tpusystem.data import Loader, SyntheticDigits
 
-    consumer, fixtures = tracked
+    (consumer, saver), fixtures = tracked
     model = Model(identity='m2', epoch=1)
     network = MLP(features=(8,), classes=4)
     model._parts = {'nn': network, 'criterion': object()}
     loader = Loader(SyntheticDigits(samples=16, seed=0), batch_size=4)
-    consumer.consume(Iterated(model, loaders={'train': loader}))
+    event = Iterated(model, loaders={'train': loader})
+    consumer.consume(event)
+    saver.consume(event)   # all-hosts consumer: collective sharded save
 
     by_kind = {row.kind: row for row in fixtures['modules'].list('m2')}
     assert by_kind['nn'].name == 'MLP'

@@ -17,14 +17,15 @@ from tpusystem.observe.logs import logging_consumer
 from tpusystem.observe.profile import StepTimer, annotate, step_span, trace
 from tpusystem.observe.tensorboard import SummaryWriter, tensorboard_consumer
 from tpusystem.observe.tracking import (
-    experiment, metrics_store, models_store, modules_store, iterations_store,
-    repository, tracking_consumer,
+    checkpoint_consumer, experiment, metrics_store, models_store,
+    modules_store, iterations_store, repository, tracking_consumer,
 )
 
 __all__ = [
     'Trained', 'Validated', 'Iterated', 'StepTimed',
     'logging_consumer', 'SummaryWriter', 'tensorboard_consumer',
-    'tracking_consumer', 'experiment', 'metrics_store', 'models_store',
+    'tracking_consumer', 'checkpoint_consumer', 'experiment',
+    'metrics_store', 'models_store',
     'modules_store', 'iterations_store', 'repository',
     'EventLedger', 'LedgerDivergence', 'StepTimer', 'annotate', 'step_span',
     'trace',

@@ -2,9 +2,11 @@
 
 Reference parity: ``examples/tinysys/tinysys/services/storage.py`` — fully
 event-driven tracking. ``Trained``/``Validated`` persist the metric values;
-``Iterated`` advances the model row's epoch, records registry metadata for
-the aggregate's constituent modules and the phase loaders, and snapshots the
-weights through the checkpoint repository.
+``Iterated`` advances the model row's epoch and records registry metadata
+for the aggregate's constituent modules and the phase loaders. Weight
+snapshots live in the separate :func:`checkpoint_consumer` (collective
+sharded saves must run on every host; the metadata stores here are
+``primary_only``).
 
 Conventions:
 * the aggregate's ``id`` is its registry hash (string);
@@ -108,6 +110,19 @@ def tracking_consumer() -> Consumer:
             iterations.put(ports.Iteration(
                 model=str(event.model.id), phase=str(phase), hash=digest,
                 name=alias, arguments=arguments, epoch=epoch))
+
+    return consumer
+
+
+def checkpoint_consumer() -> Consumer:
+    """Weight snapshots on every ``Iterated`` edge.
+
+    Deliberately separate from :func:`tracking_consumer`: sharded checkpoint
+    saves are *collective* (each host writes the array shards it owns), so
+    this consumer must register on **every** host, while the metadata stores
+    above are ``primary_only``. Registering it primary-only on a pod would
+    deadlock rank 0 on the save barrier."""
+    consumer = Consumer('checkpoint')
 
     @consumer.handler
     def save_weights(event: Iterated,

@@ -25,6 +25,7 @@ Typical pod-ready epoch loop::
     runtime = Runtime()                       # env-driven; Loopback off-pod
     runtime.producer.register(logging_consumer())
     runtime.producer.register(tracking_consumer(), primary_only=True)
+    runtime.producer.register(checkpoint_consumer())   # ALL hosts: saves are collective
     for epoch in range(epochs):
         try:
             service.handle('iterate', model, loaders, metrics)
