@@ -1,0 +1,110 @@
+"""Fused chunked LM loss: parity with the materialized-logits path.
+
+``ChunkedNextTokenLoss`` + ``return_features=True`` must reproduce
+``NextTokenLoss`` over full logits exactly (same math, different
+scheduling): value parity, gradient parity, padding-mask parity, and both
+table orientations (tied ``[vocab, dim]`` GPT-2 table, untied
+``[dim, vocab]`` Llama head kernel).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusystem.models import GPT2, Llama, gpt2_tiny, llama_tiny
+from tpusystem.train import ChunkedNextTokenLoss, NextTokenLoss, flax_apply
+
+
+@pytest.fixture(scope='module')
+def tokens():
+    return jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (2, 33)), jnp.int32)
+
+
+def _pair(module_logits, module_features, tokens):
+    params = module_logits.init(jax.random.PRNGKey(0), tokens)['params']
+    logits = module_logits.apply({'params': params}, tokens)
+    features = module_features.apply({'params': params}, tokens)
+    return params, logits, features
+
+
+def test_gpt2_value_and_grad_parity(tokens):
+    reference, fused = gpt2_tiny(), gpt2_tiny(return_features=True)
+    params, logits, features = _pair(reference, fused, tokens)
+    baseline = NextTokenLoss()(logits, tokens)
+    chunked = ChunkedNextTokenLoss(chunks=4)(features, tokens)
+    np.testing.assert_allclose(float(baseline), float(chunked), rtol=2e-5)
+
+    apply_ref = flax_apply(reference)
+    apply_fused = flax_apply(fused)
+    grad_ref = jax.grad(
+        lambda p: NextTokenLoss()(apply_ref(p, tokens, None, False), tokens))(params)
+    grad_fused = jax.grad(
+        lambda p: ChunkedNextTokenLoss(chunks=4)(
+            apply_fused(p, tokens, None, False), tokens))(params)
+    flat_ref = jax.tree.leaves(grad_ref)
+    flat_fused = jax.tree.leaves(grad_fused)
+    for a, b in zip(flat_ref, flat_fused):
+        # bf16 operands + different summation order (per-chunk vs whole
+        # matrix): agreement is bounded by bf16 ulps, not exact
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-2, atol=4e-4)
+
+
+def test_llama_value_parity(tokens):
+    reference = llama_tiny()
+    fused = llama_tiny(return_features=True)
+    params, logits, features = _pair(reference, fused, tokens)
+    # untied head: table arrives [dim, vocab]
+    assert features[1].shape[0] == features[0].shape[-1]
+    baseline = NextTokenLoss()(logits, tokens)
+    chunked = ChunkedNextTokenLoss(chunks=3)(features, tokens)
+    np.testing.assert_allclose(float(baseline), float(chunked), rtol=2e-5)
+
+
+def test_padding_rows_and_masked_targets_excluded(tokens):
+    """Row count not divisible by chunks forces internal padding; explicit
+    pad ids (< 0) must also drop out, matching NextTokenLoss."""
+    fused = gpt2_tiny(return_features=True)
+    reference = gpt2_tiny()
+    masked = tokens.at[:, -5:].set(-1)
+    params, logits, features = _pair(reference, fused, masked)
+    baseline = NextTokenLoss()(logits, masked)
+    for chunks in (1, 4, 7):
+        chunked = ChunkedNextTokenLoss(chunks=chunks)(features, masked)
+        np.testing.assert_allclose(float(baseline), float(chunked), rtol=2e-5)
+
+
+def test_z_loss_parity(tokens):
+    reference, fused = gpt2_tiny(), gpt2_tiny(return_features=True)
+    params, logits, features = _pair(reference, fused, tokens)
+    baseline = NextTokenLoss(z_loss=1e-3)(logits, tokens)
+    chunked = ChunkedNextTokenLoss(chunks=4, z_loss=1e-3)(features, tokens)
+    np.testing.assert_allclose(float(baseline), float(chunked), rtol=2e-5)
+
+
+def test_square_table_requires_explicit_orientation():
+    """vocab == dim makes the table orientation ambiguous: head_logits must
+    refuse to guess (a wrong guess silently transposes the head)."""
+    from tpusystem.ops.precision import head_logits
+    features = jnp.ones((2, 3, 8), jnp.float32)
+    square = jnp.ones((8, 8), jnp.float32)
+    with pytest.raises(ValueError):
+        head_logits(features, square)
+    assert head_logits(features, square, tied=True).shape == (2, 3, 8)
+    loss = ChunkedNextTokenLoss(chunks=2, tied=True)
+    tokens = jnp.zeros((2, 3), jnp.int32)
+    assert float(loss((features, square), tokens)) > 0
+
+
+def test_llama_head_param_path_unchanged():
+    """The fused-head refactor must not move 'lm_head/kernel' — partition
+    rules and existing checkpoints key on that path."""
+    module = llama_tiny()
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), tokens)['params']
+    assert 'lm_head' in params and 'kernel' in params['lm_head']
+    dim = params['lm_head']['kernel'].shape
+    assert dim == (module.dim, module.vocab_size)

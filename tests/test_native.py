@@ -1,0 +1,109 @@
+"""Native batch-assembly core: build, parity with numpy, graceful fallback.
+
+The reference ships no native code at all (SURVEY.md §2.3); this is the
+TPU build's host-side bandwidth component. The contract under test: the
+native gather is bit-identical to numpy fancy indexing, and its absence
+(or any unusual input) degrades to numpy silently.
+"""
+
+import numpy as np
+import pytest
+
+from tpusystem.data import ArrayDataset, native
+
+
+@pytest.fixture(scope='module')
+def lib():
+    library = native.library()
+    if library is None:
+        pytest.skip('no C++ toolchain available')
+    return library
+
+
+def test_builds_and_reports_abi(lib):
+    assert lib.ts_abi_version() == 1
+    assert native.available()
+
+
+@pytest.mark.parametrize('dtype', [np.float32, np.int32, np.uint8, np.float64])
+@pytest.mark.parametrize('shape', [(), (17,), (28, 28), (3, 8, 8)])
+def test_gather_matches_numpy(lib, dtype, shape):
+    rng = np.random.default_rng(0)
+    array = rng.integers(0, 200, size=(64,) + shape).astype(dtype)
+    indices = rng.integers(0, 64, size=33)
+    np.testing.assert_array_equal(native.gather(array, indices), array[indices])
+
+
+def test_gather_into_preallocated_buffer(lib):
+    array = np.arange(40, dtype=np.float32).reshape(10, 4)
+    indices = np.array([9, 0, 3])
+    out = np.empty((3, 4), np.float32)
+    result = native.gather(array, indices, out=out)
+    assert result is out
+    np.testing.assert_array_equal(out, array[indices])
+
+
+def test_gather_large_enough_to_go_multithreaded(lib):
+    # > 1 MiB/worker threshold: exercises the threaded path
+    rng = np.random.default_rng(1)
+    array = rng.standard_normal((4096, 1024)).astype(np.float32)  # 16 MiB
+    indices = rng.permutation(4096)
+    np.testing.assert_array_equal(native.gather(array, indices), array[indices])
+
+
+def test_negative_and_out_of_range_keep_numpy_semantics(lib):
+    array = np.arange(12, dtype=np.int64).reshape(6, 2)
+    np.testing.assert_array_equal(
+        native.gather(array, np.array([-1, 0])), array[np.array([-1, 0])])
+    with pytest.raises(IndexError):
+        native.gather(array, np.array([6]))
+
+
+def test_boolean_mask_keeps_numpy_selection_semantics(lib):
+    array = np.arange(12, dtype=np.int64).reshape(6, 2)
+    mask = np.array([False, True, False, True, False, False])
+    np.testing.assert_array_equal(native.gather(array, mask), array[mask])
+
+
+def test_float_indices_raise_like_numpy(lib):
+    array = np.arange(12, dtype=np.int64).reshape(6, 2)
+    with pytest.raises(IndexError):
+        native.gather(array, np.array([1.0, 2.0]))
+
+
+def test_mismatched_out_buffer_is_validated_not_corrupted(lib):
+    array = np.arange(40, dtype=np.float32).reshape(10, 4)
+    indices = np.array([1, 2, 3])
+    wrong_dtype = np.empty((3, 4), np.float64)
+    result = native.gather(array, indices, out=wrong_dtype)  # numpy copyto path
+    np.testing.assert_array_equal(result, array[indices])
+    with pytest.raises(ValueError):
+        native.gather(array, indices, out=np.empty((2, 4), np.float32))
+
+
+def test_non_contiguous_falls_back(lib):
+    array = np.arange(48, dtype=np.float32).reshape(12, 4)[:, ::2]
+    assert not array.flags.c_contiguous
+    indices = np.array([1, 5, 0])
+    np.testing.assert_array_equal(native.gather(array, indices), array[indices])
+
+
+def test_disabled_by_env_falls_back(monkeypatch):
+    monkeypatch.setattr(native, '_lib', False)
+    monkeypatch.setenv('TPUSYSTEM_NO_NATIVE', '1')
+    assert not native.available()
+    array = np.arange(10, dtype=np.float32).reshape(5, 2)
+    np.testing.assert_array_equal(
+        native.gather(array, np.array([4, 2])), array[[4, 2]])
+    monkeypatch.setattr(native, '_lib', False)   # re-probe for other tests
+
+
+def test_array_dataset_uses_native_path(lib):
+    rng = np.random.default_rng(2)
+    inputs = rng.standard_normal((50, 7)).astype(np.float32)
+    targets = rng.integers(0, 10, size=50)
+    dataset = ArrayDataset(inputs, targets)
+    span = np.array([3, 1, 4, 1, 5])
+    got_inputs, got_targets = dataset[span]
+    np.testing.assert_array_equal(got_inputs, inputs[span])
+    np.testing.assert_array_equal(got_targets, targets[span])

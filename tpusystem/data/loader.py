@@ -26,7 +26,12 @@ from tpusystem.registry import register
 
 
 class ArrayDataset:
-    """In-memory dataset over parallel arrays (inputs, targets, ...)."""
+    """In-memory dataset over parallel arrays (inputs, targets, ...).
+
+    Batch gathers go through the native multithreaded core
+    (:mod:`tpusystem.data.native`) when it is available; results are
+    bit-identical to numpy fancy indexing either way.
+    """
 
     def __init__(self, *arrays: np.ndarray):
         lengths = {len(array) for array in arrays}
@@ -37,6 +42,9 @@ class ArrayDataset:
         return len(self.arrays[0])
 
     def __getitem__(self, index) -> tuple:
+        if isinstance(index, np.ndarray):
+            from tpusystem.data import native
+            return tuple(native.gather(array, index) for array in self.arrays)
         return tuple(array[index] for array in self.arrays)
 
 

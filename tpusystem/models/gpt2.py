@@ -17,6 +17,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from tpusystem.ops.attention import attend
+from tpusystem.ops.precision import head_logits
 from tpusystem.registry import register
 
 
@@ -130,6 +131,8 @@ class GPT2(nn.Module):
     mesh: object = None  # mesh for ring/ulysses sequence parallelism
     attn_dropout: float | None = None  # None -> follow `dropout` ('xla' only)
     remat: bool = False  # recompute each block's activations in backward
+    return_features: bool = False  # return (features, wte table) for a fused
+    # chunked LM loss (train.ChunkedNextTokenLoss) instead of full logits
     moe_experts: int = 0  # >0: MoE FFN in every `moe_every`-th block
     moe_every: int = 2
     moe_k: int = 2
@@ -167,9 +170,20 @@ class GPT2(nn.Module):
             else:
                 hidden = result
         hidden = nn.LayerNorm(dtype=jnp.float32, name='ln_f')(hidden)
-        # tied LM head: logits against the token embedding table, f32 for
-        # a numerically stable softmax/loss
-        logits = token_embedding.attend(hidden.astype(jnp.float32))
+        # tied LM head: logits against the token embedding table. The matmul
+        # runs bf16 x bf16 (MXU rate) accumulating into f32 — f32 operands
+        # here would put ~30% of the model's FLOPs on the slow path — and
+        # the f32 logits keep the softmax/loss numerically stable.
+        table = token_embedding.embedding.astype(compute_dtype)
+        if self.return_features:
+            # fused-head path: the criterion owns the head matmul and never
+            # materializes the [batch*seq, vocab] f32 logits tensor
+            features = hidden.astype(compute_dtype)
+            if self.moe_experts:
+                aux = jnp.mean(jnp.stack(aux_losses)) if aux_losses else jnp.float32(0)
+                return (features, table), aux
+            return features, table
+        logits = head_logits(hidden.astype(compute_dtype), table, tied=True)
         if self.moe_experts:
             # arity is fixed by configuration, not by which layers happened
             # to be MoE, so the WithAuxLoss pairing can't be broken by a
@@ -256,7 +270,8 @@ class GPT2Pipelined:
         # module so the two variants cannot drift numerically
         hidden = nn.LayerNorm(dtype=jnp.float32).apply(
             {'params': params['ln_f']}, hidden.astype(jnp.float32))
-        return hidden @ params['wte']['embedding'].T
+        table = params['wte']['embedding'].astype(jnp.dtype(self.dtype))
+        return head_logits(hidden, table, tied=True)
 
     def _block_fn(self):
         def block_fn(layer_params, activations):

@@ -24,6 +24,7 @@ from flax import linen as nn
 from jax.sharding import PartitionSpec as P
 
 from tpusystem.ops.attention import attend
+from tpusystem.ops.precision import head_logits
 from tpusystem.registry import register
 
 
@@ -47,6 +48,20 @@ def apply_rotary(tensor: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array
     rotated = jnp.stack(
         (even * cos - odd * sin, even * sin + odd * cos), axis=-1)
     return rotated.reshape(tensor.shape).astype(dtype)
+
+
+class _HeadKernel(nn.Module):
+    """Bare ``kernel`` parameter under the module's scope — what ``nn.Dense``
+    would create (same path, same initializer), but retrievable so the
+    fused-loss path can pass the table to the criterion."""
+
+    dim: int
+    vocab: int
+
+    @nn.compact
+    def __call__(self):
+        return self.param('kernel', nn.initializers.lecun_normal(),
+                          (self.dim, self.vocab))
 
 
 class RMSNorm(nn.Module):
@@ -151,6 +166,9 @@ class Llama(nn.Module):
     attention: str = 'xla'
     mesh: object = None
     remat: bool = False
+    return_features: bool = False  # return (features, head kernel) for a
+    # fused chunked LM loss (train.ChunkedNextTokenLoss); at 128k vocab the
+    # full f32 logits tensor is the dominant memory term
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -168,9 +186,15 @@ class Llama(nn.Module):
                                attention=self.attention, mesh=self.mesh,
                                name=f'layer_{index}')(hidden, train)
         hidden = RMSNorm(name='final_norm')(hidden)
-        # untied head (Llama-3 convention), f32 for a stable softmax/loss
-        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
-                        name='lm_head')(hidden.astype(jnp.float32))
+        # untied head (Llama-3 convention). bf16 x bf16 operands at MXU
+        # rate, f32 accumulation out for a stable softmax/loss. The kernel
+        # lives in a param holder (same 'lm_head/kernel' path a Dense would
+        # use) so the fused-loss path can hand it to the criterion.
+        kernel = _HeadKernel(self.dim, self.vocab_size, name='lm_head')()
+        table = kernel.astype(compute_dtype)
+        if self.return_features:
+            return hidden, table
+        return head_logits(hidden, table, tied=False)
 
     @staticmethod
     def partition_rules():

@@ -1,0 +1,47 @@
+"""Mixed-precision matmul helpers shared by every LM head.
+
+One rule, applied everywhere a head projects features onto a vocabulary:
+operands in the compute dtype (bf16 — MXU rate), accumulation and result in
+float32 (loss-stable softmax). Centralized so the GPT-2 tied head, the
+pipelined variant, the Llama untied head, and the fused chunked loss stay
+numerically in lockstep.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def f32_accum_dot(a, b, dimension_numbers, precision=None,
+                  preferred_element_type=None):
+    """``lax.dot_general`` that always accumulates into float32 (the
+    ``preferred_element_type`` argument of callers is deliberately ignored —
+    this signature doubles as a ``flax.linen.Dense`` ``dot_general=``)."""
+    return jax.lax.dot_general(a, b, dimension_numbers, precision=precision,
+                               preferred_element_type=jnp.float32)
+
+
+def head_logits(features, table, *, tied: bool | None = None) -> jax.Array:
+    """Project ``[..., dim]`` features onto the vocabulary: f32 logits from
+    compute-dtype operands.
+
+    ``tied=True`` means ``table`` is a ``[vocab, dim]`` embedding table
+    (GPT-2 convention); ``tied=False`` a ``[dim, vocab]`` head kernel
+    (Llama convention). ``tied=None`` infers from the shapes but refuses a
+    square table, where the orientation is ambiguous and guessing would
+    silently transpose the head."""
+    dim = features.shape[-1]
+    if tied is None:
+        if table.shape[0] == table.shape[1]:
+            raise ValueError(
+                f'square head table {table.shape}: pass tied= explicitly')
+        tied = table.shape[-1] == dim
+    table_dim = 1 if tied else 0
+    if table.shape[table_dim] != dim:
+        raise ValueError(
+            f'feature dim {dim} does not match table {table.shape} '
+            f'(tied={tied})')
+    features = features.astype(table.dtype)
+    return f32_accum_dot(
+        features, table, (((features.ndim - 1,), (table_dim,)), ((), ())))

@@ -59,6 +59,68 @@ class WithAuxLoss:
 
 
 @register
+class ChunkedNextTokenLoss:
+    """Causal LM loss fused with the LM head, chunked over rows.
+
+    Consumes ``(features, table)`` from a model built with
+    ``return_features=True`` (:class:`tpusystem.models.GPT2` /
+    :class:`~tpusystem.models.Llama`) instead of materialized logits. The
+    ``[batch*seq, vocab]`` float32 logits tensor — several GB at LM scale,
+    and the usual OOM driver — is never formed: rows are processed in
+    ``chunks`` sequential slices, each computing its logits tile at MXU
+    rate (bf16 operands, f32 accumulation), reducing to its loss
+    contribution, and being rematerialized in the backward pass
+    (``jax.checkpoint``), so peak memory drops by ~``chunks``x while FLOPs
+    stay within 2x on the head only.
+
+    Same semantics as :class:`NextTokenLoss`: logits[:, :-1] vs
+    tokens[:, 1:], pad ids < 0 masked out, optional z-loss. ``table`` may
+    be ``[vocab, dim]`` (tied embedding) or ``[dim, vocab]`` (untied head
+    kernel).
+    """
+
+    def __init__(self, chunks: int = 16, z_loss: float = 0.0,
+                 tied: bool | None = None):
+        self.chunks = chunks
+        self.z_loss = z_loss
+        # table orientation; None infers from shapes and refuses the
+        # ambiguous square case (vocab == dim) — pass explicitly there
+        self.tied = tied
+
+    def __call__(self, outputs, tokens):
+        from tpusystem.ops.precision import head_logits
+
+        features, table = outputs
+        dim = features.shape[-1]
+        rows = features[:, :-1].reshape(-1, dim)
+        labels = tokens[:, 1:].reshape(-1)
+        padding = -rows.shape[0] % self.chunks
+        if padding:
+            rows = jnp.pad(rows, ((0, padding), (0, 0)))
+            labels = jnp.pad(labels, (0, padding), constant_values=-1)
+        rows = rows.reshape(self.chunks, -1, dim)
+        labels = labels.reshape(self.chunks, -1)
+
+        @jax.checkpoint
+        def chunk(rows_chunk, labels_chunk):
+            logits = head_logits(rows_chunk, table, tied=self.tied)
+            logsumexp = jax.nn.logsumexp(logits, axis=-1)
+            mask = (labels_chunk >= 0).astype(jnp.float32)
+            safe = jnp.maximum(labels_chunk, 0)
+            true_logit = jnp.take_along_axis(logits, safe[:, None], axis=1)[:, 0]
+            return (jnp.sum((logsumexp - true_logit) * mask),
+                    jnp.sum(jnp.square(logsumexp) * mask), jnp.sum(mask))
+
+        losses, z_terms, counts = jax.lax.map(
+            lambda slices: chunk(*slices), (rows, labels))
+        total = jnp.maximum(jnp.sum(counts), 1.0)
+        loss = jnp.sum(losses) / total
+        if self.z_loss:
+            loss = loss + self.z_loss * jnp.sum(z_terms) / total
+        return loss
+
+
+@register
 class NextTokenLoss:
     """Causal LM loss: cross-entropy of logits[:, :-1] vs tokens[:, 1:],
     with padding mask support (pad id < 0 excluded)."""
