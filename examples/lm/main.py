@@ -1,0 +1,294 @@
+"""Language-model pretraining system — the tinysys architecture at LM scale.
+
+The same message-driven stack as ``examples/tinysys`` (compiler pipeline,
+named service handlers, event consumers, resume-by-identity), applied to
+the BASELINE.md ladder-4 workload: a GPT-2 aggregate trained with the
+fused chunked LM loss under an FSDP sharding policy on the job's mesh.
+Every piece is a DI seam: swap the mesh, the policy (e.g.
+``TensorParallel(GPT2.partition_rules(), fsdp=True)``), the dataset
+(``MemmapTokens('corpus.bin')`` for a real corpus), or the preset from
+this composition root without touching the system.
+
+Run: ``python main.py [epochs]``  (tiny preset; ``--full`` for 125M).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+
+from tpusystem import Aggregate, Compiler, Depends, Runtime
+from tpusystem.checkpoint import Repository
+from tpusystem.data import Loader, SyntheticTokens
+from tpusystem.depends import Provider
+from tpusystem.models import GPT2, gpt2_tiny
+from tpusystem.observe import checkpoint_consumer, logging_consumer, tracking
+from tpusystem.observe.events import Iterated, Trained, Validated
+from tpusystem.observe.profile import StepTimer
+from tpusystem.parallel import (FullyShardedDataParallel, MeshSpec,
+                                batch_sharding)
+from tpusystem.registry import gethash
+from tpusystem.services import Producer, Service
+from tpusystem.storage import (DocumentIterations, DocumentMetrics,
+                               DocumentModels, DocumentModules, DocumentStore)
+from tpusystem.train import (AdamW, ChunkedNextTokenLoss, Mean, Perplexity,
+                             build_eval_step, build_train_step, flax_apply,
+                             init_state)
+
+ROOT = pathlib.Path(__file__).parent / 'data'
+
+
+# --------------------------------------------------------------------------
+# aggregate
+
+class LanguageModel(Aggregate):
+    """Network + criterion + optimizer as one identity-bearing unit; the
+    math is two jitted steps over an FSDP-sharded TrainState."""
+
+    def __init__(self, network, criterion, optimizer):
+        super().__init__()
+        self.network = network
+        self.criterion = criterion
+        self.optimizer = optimizer
+        self.state = None
+        self.mesh = None
+        self.epoch = 0
+        apply_fn = flax_apply(network)
+        self._train_step = build_train_step(apply_fn, criterion, optimizer)
+        self._eval_step = build_eval_step(apply_fn, criterion)
+
+    @property
+    def id(self) -> str:
+        return gethash(self.network)
+
+    def modules(self):
+        return {'nn': self.network, 'criterion': self.criterion,
+                'optimizer': self.optimizer}
+
+    def place(self, sample_tokens, mesh, policy) -> None:
+        self.mesh = mesh
+        state = init_state(self.network, self.optimizer, sample_tokens)
+        self.state = policy.place(state, mesh)
+
+    def shard_batch(self, tokens):
+        return jax.device_put(tokens, batch_sharding(self.mesh))
+
+    def fit(self, tokens):
+        self.state, (_, loss) = self._train_step(self.state, tokens, tokens)
+        return loss
+
+    def evaluate(self, tokens):
+        _, loss = self._eval_step(self.state, tokens, tokens)
+        return loss
+
+    def onepoch(self) -> None:
+        self.events.commit()
+
+
+# --------------------------------------------------------------------------
+# metrics
+
+class LMMetrics:
+    """Loss + perplexity, accumulated on device, one sync per phase."""
+
+    def __init__(self):
+        self.loss = Mean()
+        self.perplexity = Perplexity()
+
+    def update(self, loss) -> None:
+        self.loss.update(loss)
+        self.perplexity.update(loss)
+
+    def compute(self) -> dict:
+        return {'loss': self.loss.compute(),
+                'perplexity': self.perplexity.compute()}
+
+    def reset(self) -> None:
+        self.loss.reset()
+        self.perplexity.reset()
+
+
+# --------------------------------------------------------------------------
+# compilation pipeline
+
+provider = Provider()
+compiler = Compiler[LanguageModel](provider=provider)
+
+
+def mesh():
+    """FSDP over every chip in the job (a 1x1 mesh on one chip)."""
+    return MeshSpec(fsdp=-1).build()
+
+
+def policy():
+    return FullyShardedDataParallel()
+
+
+def sample_tokens():
+    return jnp.zeros((1, 8), jnp.int32)
+
+
+def models():
+    raise NotImplementedError('override the models store dependency')
+
+
+def repository():
+    raise NotImplementedError('override the repository dependency')
+
+
+def experiment() -> str:
+    return 'lm'
+
+
+@compiler.step
+def build(network, criterion, optimizer) -> LanguageModel:
+    return LanguageModel(network, criterion, optimizer)
+
+
+@compiler.step
+def place_on_mesh(model: LanguageModel, device_mesh=Depends(mesh),
+                  sharding=Depends(policy),
+                  sample=Depends(sample_tokens)) -> LanguageModel:
+    model.place(sample, device_mesh, sharding)
+    return model
+
+
+@compiler.step
+def bring_epoch(model: LanguageModel, store=Depends(models),
+                name: str = Depends(experiment)) -> LanguageModel:
+    from tpusystem.storage import ports
+    row = store.read(str(model.id), name)
+    if row is None:
+        store.create(ports.Model(hash=str(model.id), experiment=name, epoch=0))
+        return model
+    if row.epoch < model.epoch:
+        raise ValueError(f'epoch regression: store at {row.epoch}')
+    model.epoch = row.epoch
+    return model
+
+
+@compiler.step
+def restore_weights(model: LanguageModel,
+                    weights=Depends(repository)) -> LanguageModel:
+    if model.epoch > 0:
+        weights.restore(model)
+    return model
+
+
+# --------------------------------------------------------------------------
+# training service
+
+service = Service(provider=provider)
+producer = Producer()
+
+
+@service.handler
+def iterate(model, loaders, metrics) -> None:
+    train(model, loaders['train'], metrics)
+    metrics.reset()
+    validate(model, loaders['evaluation'], metrics)
+    metrics.reset()
+    try:
+        model.epoch += 1
+    finally:
+        producer.dispatch(Iterated(model, loaders))
+
+
+@service.handler
+def train(model, loader, metrics) -> None:
+    model.phase = 'train'
+    timer = StepTimer(producer).start()
+    for (tokens,) in loader:
+        tokens = model.shard_batch(tokens)
+        metrics.update(model.fit(tokens))
+    results = metrics.compute()
+    timer.stop(model, 'train', steps=len(loader))
+    producer.dispatch(Trained(model, results))
+
+
+@service.handler
+def validate(model, loader, metrics) -> None:
+    model.phase = 'evaluation'
+    timer = StepTimer(producer).start()
+    for (tokens,) in loader:
+        tokens = model.shard_batch(tokens)
+        metrics.update(model.evaluate(tokens))
+    results = metrics.compute()
+    timer.stop(model, 'evaluation', steps=len(loader))
+    producer.dispatch(Validated(model, results))
+
+
+# --------------------------------------------------------------------------
+# composition root
+
+def main(epochs: int = 3, full: bool = False) -> None:
+    global producer
+    logging.basicConfig(level=logging.INFO, format='%(message)s', force=True)
+    for noisy in ('orbax', 'absl', 'jax'):
+        logging.getLogger(noisy).setLevel(logging.WARNING)
+    runtime = Runtime()
+    store = DocumentStore(ROOT / 'experiments.json')
+    weights = Repository(ROOT / 'weights')
+
+    tracker = tracking.tracking_consumer()
+    tracker.dependency_overrides.update({
+        tracking.metrics_store: lambda: DocumentMetrics(store),
+        tracking.models_store: lambda: DocumentModels(store),
+        tracking.modules_store: lambda: DocumentModules(store),
+        tracking.iterations_store: lambda: DocumentIterations(store),
+        tracking.repository: lambda: weights,
+        tracking.experiment: experiment,
+    })
+    runtime.producer.register(tracker, primary_only=True)
+    saver = checkpoint_consumer()
+    saver.dependency_overrides[tracking.repository] = lambda: weights
+    runtime.producer.register(saver)
+    runtime.producer.register(logging_consumer())
+    producer = runtime.producer
+
+    provider.override(models, lambda: DocumentModels(store))
+    provider.override(repository, lambda: weights)
+
+    if full:
+        network = GPT2(vocab_size=50304, dropout=0.0, return_features=True)
+        sequence, batch = 1024, 16
+    else:
+        network = gpt2_tiny(return_features=True)
+        sequence, batch = 128, 16
+    model = compiler.compile(network, ChunkedNextTokenLoss(chunks=8),
+                             AdamW(lr=3e-4, grad_clip=1.0))
+
+    dataset = SyntheticTokens(samples=64 * batch, sequence_length=sequence,
+                              vocab_size=min(network.vocab_size, 256))
+    holdout = SyntheticTokens(samples=8 * batch, sequence_length=sequence,
+                              vocab_size=min(network.vocab_size, 256), seed=1)
+    loaders = {'train': Loader(dataset, batch_size=batch, shuffle=True, seed=0),
+               'evaluation': Loader(holdout, batch_size=batch)}
+    metrics = LMMetrics()
+
+    print(f'pretraining {model.id} from epoch {model.epoch}')
+    try:
+        for _ in range(model.epoch, epochs):
+            wants_stop = False
+            try:
+                service.handle('iterate', model, loaders, metrics)
+            except StopIteration:
+                wants_stop = True
+            runtime.sync()
+            if runtime.should_stop(wants_stop):
+                break
+    finally:
+        with contextlib.ExitStack() as cleanup:
+            cleanup.callback(runtime.close)
+            cleanup.callback(store.close)
+            weights.close()
+
+
+if __name__ == '__main__':
+    arguments = [argument for argument in sys.argv[1:] if argument != '--full']
+    main(int(arguments[0]) if arguments else 3, full='--full' in sys.argv)

@@ -81,6 +81,15 @@ def test_mismatched_out_buffer_is_validated_not_corrupted(lib):
         native.gather(array, indices, out=np.empty((2, 4), np.float32))
 
 
+def test_overlapping_out_buffer_stays_correct(lib):
+    """out aliasing the source must not be fed to the raw memcpy — numpy
+    materializes array[indices] first, so [5, 0] into a[:2] is [a5, a0]."""
+    array = np.arange(12, dtype=np.float32).reshape(6, 2)
+    expected = array[np.array([5, 0])].copy()
+    result = native.gather(array, np.array([5, 0]), out=array[:2])
+    np.testing.assert_array_equal(result, expected)
+
+
 def test_non_contiguous_falls_back(lib):
     array = np.arange(48, dtype=np.float32).reshape(12, 4)[:, ::2]
     assert not array.flags.c_contiguous
@@ -107,3 +116,55 @@ def test_array_dataset_uses_native_path(lib):
     got_inputs, got_targets = dataset[span]
     np.testing.assert_array_equal(got_inputs, inputs[span])
     np.testing.assert_array_equal(got_targets, targets[span])
+
+
+class TestMemmapTokens:
+    @pytest.fixture()
+    def corpus(self, tmp_path):
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, 50000, size=1000, dtype=np.uint16)
+        path = tmp_path / 'corpus.bin'
+        tokens.tofile(path)
+        return path, tokens
+
+    def test_windows_and_dtype(self, corpus):
+        from tpusystem.data import MemmapTokens
+        path, tokens = corpus
+        ds = MemmapTokens(path, sequence_length=128)
+        assert len(ds) == (1000 - 129) // 128 + 1
+        (window,) = ds[2]
+        assert window.dtype == np.int32 and window.shape == (129,)
+        np.testing.assert_array_equal(window, tokens[256:256 + 129])
+
+    def test_batched_gather(self, corpus):
+        from tpusystem.data import MemmapTokens
+        path, tokens = corpus
+        ds = MemmapTokens(path, sequence_length=64, stride=32)
+        span = np.array([0, 3, 5])
+        (batch,) = ds[span]
+        assert batch.shape == (3, 65)
+        np.testing.assert_array_equal(batch[1], tokens[96:96 + 65])
+
+    def test_loader_integration(self, corpus):
+        from tpusystem.data import Loader, MemmapTokens
+        path, _ = corpus
+        ds = MemmapTokens(path, sequence_length=64)
+        loader = Loader(ds, batch_size=4, shuffle=True, seed=7)
+        batches = list(loader)
+        assert len(batches) == len(ds) // 4
+        (first,) = batches[0]
+        assert first.shape == (4, 65)
+
+    def test_too_small_corpus_raises(self, tmp_path):
+        from tpusystem.data import MemmapTokens
+        path = tmp_path / 'tiny.bin'
+        np.arange(10, dtype=np.uint16).tofile(path)
+        with pytest.raises(ValueError):
+            MemmapTokens(path, sequence_length=128)
+
+    def test_registered_identity_excludes_nothing(self, corpus):
+        from tpusystem.data import MemmapTokens
+        from tpusystem.registry import getarguments
+        path, _ = corpus
+        ds = MemmapTokens(path, sequence_length=64)
+        assert getarguments(ds)['sequence_length'] == 64

@@ -1,4 +1,6 @@
 from tpusystem.data.loader import ArrayDataset, Loader
-from tpusystem.data.datasets import SyntheticDigits, SyntheticTokens, TorchDataset
+from tpusystem.data.datasets import (MemmapTokens, SyntheticDigits,
+                                     SyntheticTokens, TorchDataset)
 
-__all__ = ['ArrayDataset', 'Loader', 'SyntheticDigits', 'SyntheticTokens', 'TorchDataset']
+__all__ = ['ArrayDataset', 'Loader', 'MemmapTokens', 'SyntheticDigits',
+           'SyntheticTokens', 'TorchDataset']

@@ -101,9 +101,12 @@ def gather(array: np.ndarray, indices: np.ndarray,
     expected_shape = (len(indices),) + array.shape[1:] if indices.ndim == 1 else None
     if native_ok and out is not None:
         # a caller-supplied buffer is written as raw bytes: only accept it
-        # when that is exactly equivalent to numpy's element-wise copy
+        # when that is exactly equivalent to numpy's element-wise copy —
+        # including not aliasing the source (the raw memcpy reads rows the
+        # previous row's write may already have clobbered)
         native_ok = (out.shape == expected_shape and out.dtype == array.dtype
-                     and out.flags.c_contiguous)
+                     and out.flags.c_contiguous
+                     and not np.shares_memory(out, array))
     if not native_ok:
         fallback = array[indices]
         if out is None:
