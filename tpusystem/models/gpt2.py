@@ -41,6 +41,8 @@ class SelfAttention(nn.Module):
     kernel: str = 'xla'    # 'xla' | 'flash' (Pallas) | 'ring' | 'ulysses'
     mesh: object = None    # required for 'ring'/'ulysses' (seq-sharded)
     attn_dropout: float | None = None  # None -> follow `dropout` ('xla' only)
+    decode: bool = False   # KV-cache incremental decoding (xla kernel only)
+    max_seq: int = 1024    # cache capacity when decoding
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -58,11 +60,15 @@ class SelfAttention(nn.Module):
         query, key, value = jnp.split(qkv, 3, axis=-1)
         shape = hidden.shape[:2] + (self.heads, head_dim)
         query, key, value = (t.reshape(shape) for t in (query, key, value))
-        dropout = attn_dropout if train else 0.0
-        context = attend(
-            query, key, value, kernel=self.kernel, mesh=self.mesh, causal=True,
-            dropout=dropout,
-            dropout_rng=self.make_rng('dropout') if dropout else None)
+        if self.decode:
+            from tpusystem.ops.attention import cached_attention
+            context = cached_attention(self, query, key, value, self.max_seq)
+        else:
+            dropout = attn_dropout if train else 0.0
+            context = attend(
+                query, key, value, kernel=self.kernel, mesh=self.mesh,
+                causal=True, dropout=dropout,
+                dropout_rng=self.make_rng('dropout') if dropout else None)
         context = context.reshape(hidden.shape)
         return nn.Dense(dim, dtype=self.dtype, name='out')(context)
 
@@ -79,6 +85,8 @@ class Block(nn.Module):
     attention: str = 'xla'
     mesh: object = None
     attn_dropout: float | None = None
+    decode: bool = False
+    max_seq: int = 1024
     moe_experts: int = 0
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -90,6 +98,7 @@ class Block(nn.Module):
         attended = SelfAttention(self.heads, self.dropout, self.dtype,
                                  kernel=self.attention, mesh=self.mesh,
                                  attn_dropout=self.attn_dropout,
+                                 decode=self.decode, max_seq=self.max_seq,
                                  name='attn')(
             normed.astype(self.dtype), train)
         attended = nn.Dropout(self.dropout, deterministic=not train)(attended)
@@ -133,6 +142,8 @@ class GPT2(nn.Module):
     remat: bool = False  # recompute each block's activations in backward
     return_features: bool = False  # return (features, wte table) for a fused
     # chunked LM loss (train.ChunkedNextTokenLoss) instead of full logits
+    decode: bool = False  # KV-cache autoregressive decoding (see
+    # tpusystem.train.generate; apply with mutable=['cache'])
     moe_experts: int = 0  # >0: MoE FFN in every `moe_every`-th block
     moe_every: int = 2
     moe_k: int = 2
@@ -141,7 +152,15 @@ class GPT2(nn.Module):
     @nn.compact
     def __call__(self, tokens, train: bool = False):
         compute_dtype = jnp.dtype(self.dtype)
-        positions = jnp.arange(tokens.shape[-1])
+        if self.decode:
+            # absolute positions continue from the cache cursor
+            offset = self.variable('cache', 'position',
+                                   lambda: jnp.zeros((), jnp.int32))
+            positions = offset.value + jnp.arange(tokens.shape[-1])
+            if not self.is_initializing():
+                offset.value = offset.value + tokens.shape[-1]
+        else:
+            positions = jnp.arange(tokens.shape[-1])
         token_embedding = nn.Embed(self.vocab_size, self.dim,
                                    dtype=jnp.float32, name='wte')
         hidden = token_embedding(tokens)
@@ -159,6 +178,7 @@ class GPT2(nn.Module):
             block = block_cls(self.heads, self.mlp_ratio, self.dropout,
                               compute_dtype, attention=self.attention,
                               mesh=self.mesh, attn_dropout=self.attn_dropout,
+                              decode=self.decode, max_seq=self.max_seq,
                               moe_experts=self.moe_experts if is_moe else 0,
                               moe_k=self.moe_k,
                               moe_capacity_factor=self.moe_capacity_factor,

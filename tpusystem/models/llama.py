@@ -94,6 +94,8 @@ class LlamaAttention(nn.Module):
     rope_theta: float = 500_000.0
     kernel: str = 'xla'
     mesh: object = None
+    decode: bool = False
+    max_seq: int = 8192
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -109,13 +111,26 @@ class LlamaAttention(nn.Module):
         key = key.reshape(batch, length, self.kv_heads, head_dim)
         value = value.reshape(batch, length, self.kv_heads, head_dim)
 
-        cos, sin = rotary_embedding(jnp.arange(length), head_dim,
-                                    self.rope_theta)
+        if self.decode:
+            # rotary runs at absolute positions: peek at the cache cursor
+            # (declared and advanced by cached_attention; absent on the
+            # prefill call, where the offset is 0)
+            cursor = (self.get_variable('cache', 'index')
+                      if self.has_variable('cache', 'index')
+                      else jnp.zeros((), jnp.int32))
+            positions = cursor + jnp.arange(length)
+        else:
+            positions = jnp.arange(length)
+        cos, sin = rotary_embedding(positions, head_dim, self.rope_theta)
         query = apply_rotary(query, cos, sin)
         key = apply_rotary(key, cos, sin)
 
-        context = attend(query, key, value, kernel=self.kernel,
-                         mesh=self.mesh, causal=True)
+        if self.decode:
+            from tpusystem.ops.attention import cached_attention
+            context = cached_attention(self, query, key, value, self.max_seq)
+        else:
+            context = attend(query, key, value, kernel=self.kernel,
+                             mesh=self.mesh, causal=True)
         context = context.reshape(batch, length, dim)
         return dense(dim, 'out')(context)
 
@@ -130,6 +145,8 @@ class LlamaBlock(nn.Module):
     rope_theta: float = 500_000.0
     attention: str = 'xla'
     mesh: object = None
+    decode: bool = False
+    max_seq: int = 8192
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -137,7 +154,8 @@ class LlamaBlock(nn.Module):
         normed = RMSNorm(name='attn_norm')(hidden)
         hidden = hidden + LlamaAttention(
             self.heads, self.kv_heads, self.dtype, self.rope_theta,
-            kernel=self.attention, mesh=self.mesh, name='attn')(normed, train)
+            kernel=self.attention, mesh=self.mesh, decode=self.decode,
+            max_seq=self.max_seq, name='attn')(normed, train)
         normed = RMSNorm(name='ffn_norm')(hidden)
         dense = lambda features, name: nn.Dense(
             features, use_bias=False, dtype=self.dtype, name=name)
@@ -169,6 +187,8 @@ class Llama(nn.Module):
     return_features: bool = False  # return (features, head kernel) for a
     # fused chunked LM loss (train.ChunkedNextTokenLoss); at 128k vocab the
     # full f32 logits tensor is the dominant memory term
+    decode: bool = False  # KV-cache autoregressive decoding (see
+    # tpusystem.train.generate; apply with mutable=['cache'])
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -184,6 +204,7 @@ class Llama(nn.Module):
             hidden = block_cls(self.heads, self.kv_heads, self.ffn_dim,
                                compute_dtype, self.rope_theta,
                                attention=self.attention, mesh=self.mesh,
+                               decode=self.decode, max_seq=self.max_seq,
                                name=f'layer_{index}')(hidden, train)
         hidden = RMSNorm(name='final_norm')(hidden)
         # untied head (Llama-3 convention). bf16 x bf16 operands at MXU

@@ -70,6 +70,54 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
                      "expected 'xla', 'flash', 'ring' or 'ulysses'")
 
 
+def cached_attention(module, query, key, value, max_seq: int):
+    """Incremental (KV-cache) attention for autoregressive decoding.
+
+    Called from inside a flax module in decode mode: maintains
+    ``key``/``value``/``index`` variables in the ``'cache'`` collection
+    (apply with ``mutable=['cache']``), appends this call's KV at the
+    cache cursor, and attends the new queries over every filled position.
+    KV is cached at its own head count — grouped-query broadcast happens
+    inside :func:`dot_product_attention` — so the cache stays small under
+    GQA. The single implementation behind both LM families' decode paths.
+
+    Capacity contract: the caller keeps cumulative tokens within
+    ``max_seq`` (:func:`tpusystem.train.generate` enforces it up front).
+    Past capacity the cursor is a traced value, so no in-program error is
+    possible — writes would clamp and attention would read clobbered
+    positions.
+    """
+    batch, length, kv_heads, head_dim = key.shape
+    # Prefill is the call that creates the cache variables: detect it
+    # before declaring them, so the prompt can attend over just its own
+    # fresh K/V (causal) instead of the max_seq-wide zero-padded cache —
+    # at Llama's max_seq=8192 a 128-token prompt would otherwise build
+    # 64x oversized score tensors, all masked away.
+    prefill = not module.has_variable('cache', 'index')
+    cache_shape = (batch, max_seq, kv_heads, head_dim)
+    cache_key = module.variable('cache', 'key', jnp.zeros, cache_shape, key.dtype)
+    cache_value = module.variable('cache', 'value', jnp.zeros, cache_shape,
+                                  value.dtype)
+    index = module.variable('cache', 'index',
+                            lambda: jnp.zeros((), jnp.int32))
+    if module.is_initializing():
+        return dot_product_attention(query, key, value, causal=True)
+    cursor = index.value
+    cache_key.value = jax.lax.dynamic_update_slice(
+        cache_key.value, key.astype(cache_key.value.dtype), (0, cursor, 0, 0))
+    cache_value.value = jax.lax.dynamic_update_slice(
+        cache_value.value, value.astype(cache_value.value.dtype),
+        (0, cursor, 0, 0))
+    index.value = cursor + length
+    if prefill:
+        return dot_product_attention(query, key, value, causal=True)
+    # attend causally over the filled prefix: key position <= cursor + offset
+    mask = (jnp.arange(max_seq)[None, :]
+            <= cursor + jnp.arange(length)[:, None])
+    return dot_product_attention(query, cache_key.value, cache_value.value,
+                                 causal=False, mask=mask)
+
+
 def dot_product_attention(query, key, value, *, causal: bool = True,
                           mask=None, scale: float | None = None,
                           dropout: float = 0.0, dropout_rng=None):

@@ -1,0 +1,110 @@
+"""Autoregressive text generation with a KV cache.
+
+The inference counterpart of the training stack: ``generate`` clones an LM
+module into decode mode (KV caches in the flax ``'cache'`` collection,
+absolute positions from the cache cursor), prefills the prompt in one
+forward pass, then decodes one token per step under ``lax.scan`` — the
+whole sampling loop is a single compiled program, no host round-trip per
+token. Works with any module exposing the family conventions
+(:class:`tpusystem.models.GPT2` / :class:`~tpusystem.models.Llama`):
+a ``decode`` field, logits output, and ``max_seq`` capacity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _decoder(module):
+    """Clone the module into decode mode: xla attention (flash/ring make no
+    sense one token at a time), no dropout, logits output. The mesh field
+    is dropped too — the decode path never reads it, and an unhashable live
+    mesh would defeat the compiled-program cache."""
+    if getattr(module, 'moe_experts', 0):
+        raise NotImplementedError(
+            'KV-cache decoding is not implemented for MoE-configured models '
+            '(the aux-loss output and expert dispatch are training-shaped)')
+    updates: dict = {'decode': True}
+    for field, value in (('attention', 'xla'), ('dropout', 0.0),
+                         ('return_features', False), ('remat', False),
+                         ('mesh', None)):
+        if hasattr(module, field):
+            updates[field] = value
+    return dataclasses.replace(module, **updates)
+
+
+def _sample(logits, temperature: float, rng):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / temperature
+    return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+
+
+def generate(module, params, prompt, *, steps: int,
+             temperature: float = 0.0, rng=None):
+    """Generate ``steps`` tokens after ``prompt``.
+
+    Args:
+        module: the trained LM module (its ``decode=True`` clone is used).
+        params: trained parameters.
+        prompt: int32 ``[batch, prompt_len]`` token ids.
+        steps: tokens to generate per sequence.
+        temperature: 0 = greedy argmax; otherwise categorical sampling.
+        rng: ``jax.random`` key (required when ``temperature > 0``).
+
+    Returns:
+        int32 ``[batch, prompt_len + steps]`` — prompt plus generation.
+    """
+    if steps < 1:
+        raise ValueError(f'steps must be >= 1, got {steps}')
+    if temperature > 0.0 and rng is None:
+        raise ValueError('temperature sampling needs an rng key')
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    decoder = _decoder(module)
+    if prompt.shape[1] + steps > decoder.max_seq:
+        raise ValueError(
+            f'prompt ({prompt.shape[1]}) + steps ({steps}) exceeds the '
+            f'cache capacity max_seq={decoder.max_seq}')
+    try:
+        # jit caches key on function identity: reuse one compiled program
+        # per (decoder config, steps, temperature) across generate() calls
+        run = _compiled(decoder, steps, temperature)
+    except TypeError:       # unhashable module field (e.g. a live mesh)
+        run = _build(decoder, steps, temperature)
+    return run(params, prompt, rng)
+
+
+@functools.cache
+def _compiled(decoder, steps: int, temperature: float):
+    return _build(decoder, steps, temperature)
+
+
+def _build(decoder, steps: int, temperature: float):
+
+    @jax.jit
+    def run(params, prompt, rng):
+        # prefill: one pass over the prompt builds every layer's cache
+        logits, state = decoder.apply({'params': params}, prompt,
+                                      mutable=['cache'])
+        rng, key = jax.random.split(rng)
+        token = _sample(logits[:, -1], temperature, key)
+
+        def step(carry, _):
+            cache, token, rng = carry
+            logits, updated = decoder.apply(
+                {'params': params, 'cache': cache}, token[:, None],
+                mutable=['cache'])
+            rng, key = jax.random.split(rng)
+            next_token = _sample(logits[:, -1], temperature, key)
+            return (updated['cache'], next_token, rng), token
+
+        (_, last, _), generated = jax.lax.scan(
+            step, (state['cache'], token, rng), None, length=steps - 1)
+        generated = jnp.moveaxis(generated, 0, 1)       # [B, steps-1]
+        return jnp.concatenate([prompt, generated, last[:, None]], axis=1)
+
+    return run
