@@ -313,9 +313,176 @@ class TestFailureDetection:
             consumer = Consumer()
             consumer.register(WorkerLost, lost.append)
             producer.register(consumer)
-            # rank 1 stays silent past the timeout
-            assert wait_until(lambda: not producer._inbox.empty(), timeout=5)
-            producer.drain()
-            assert lost and lost[0].rank == 1
+            # rank 1 stays silent past the timeout; keep draining until the
+            # loss surfaces (a 'joined' frame may land in the inbox first)
+            assert wait_until(lambda: (producer.drain(), bool(lost))[1],
+                              timeout=5)
+            assert lost[0].rank == 1
         finally:
             shutdown(hub, transports)
+
+
+class TestRecovery:
+    def test_crash_unwinds_as_worker_lost_error_at_drain(self):
+        """detect (control plane) -> decide (recovery consumer) -> the
+        error surfaces on the host loop thread at the drain point, never
+        on a transport thread."""
+        from tpusystem.parallel.recovery import WorkerLostError, recovery_consumer
+        hub, transports = pod(2)
+        try:
+            producer = DistributedProducer(transports[0])
+            producer.register(recovery_consumer())
+            transports[1]._sock.shutdown(socket.SHUT_RDWR)
+            transports[1]._sock.close()
+            # wait for the 'lost' broadcast specifically — a late 'joined'
+            # control frame can land in the inbox first
+            assert wait_until(lambda: 1 in hub._lost)
+            assert wait_until(lambda: not producer._inbox.empty())
+            with pytest.raises(WorkerLostError) as excinfo:
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:   # drain until it surfaces
+                    producer.drain()
+                    time.sleep(0.01)
+            assert excinfo.value.rank == 1
+        finally:
+            shutdown(hub, transports)
+
+    def test_collectives_degrade_to_survivors_after_loss(self):
+        """The 'observe' policy is only viable if collectives stop waiting
+        for the dead rank: an allreduce started by the survivors completes
+        with their contributions once the loss is detected."""
+        import threading
+        hub, transports = pod(3)
+        try:
+            transports[2]._sock.shutdown(socket.SHUT_RDWR)
+            transports[2]._sock.close()
+            assert wait_until(lambda: 2 in hub._lost)
+            results = {}
+
+            def contribute(rank):
+                results[rank] = transports[rank].allreduce(rank == 0, op='or',
+                                                           timeout=10)
+
+            threads = [threading.Thread(target=contribute, args=(rank,))
+                       for rank in (0, 1)]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join(timeout=10)
+            assert results == {0: True, 1: True}
+        finally:
+            shutdown(hub, transports)
+
+    def test_pending_collective_completes_when_holdout_dies(self):
+        """Loss DURING a collective: the op was waiting on the dead rank
+        and must complete with the survivors' values."""
+        import threading
+        hub, transports = pod(3)
+        try:
+            results = {}
+
+            def contribute(rank):
+                results[rank] = transports[rank].allreduce(rank, op='sum',
+                                                           timeout=10)
+
+            threads = [threading.Thread(target=contribute, args=(rank,))
+                       for rank in (0, 1)]
+            for thread in threads:
+                thread.start()
+            assert wait_until(lambda: len(hub._pending) == 1)
+            transports[2]._sock.shutdown(socket.SHUT_RDWR)
+            transports[2]._sock.close()
+            for thread in threads:
+                thread.join(timeout=10)
+            assert results == {0: 1, 1: 1}   # sum of ranks 0 + 1
+        finally:
+            shutdown(hub, transports)
+
+    def test_vote_then_die_still_counts_and_survivor_vote_not_dropped(self):
+        """A contribution received before the crash stays in the result;
+        quota completion is keyed by rank, so the dead rank's early vote
+        cannot displace a survivor's."""
+        import threading
+        hub, transports = pod(3)
+        try:
+            results = {}
+
+            def contribute(rank, value):
+                results[rank] = transports[rank].allreduce(value, op='sum',
+                                                           timeout=10)
+
+            # rank 2 votes first, then dies (its own call can never return —
+            # swallow the timeout so the daemon thread exits quietly)
+            def doomed_vote():
+                try:
+                    transports[2].allreduce(10, op='sum', timeout=2)
+                except Exception:
+                    pass
+
+            doomed = threading.Thread(target=doomed_vote, daemon=True)
+            doomed.start()
+            assert wait_until(
+                lambda: any(2 in values for values in hub._pending.values()))
+            transports[2]._sock.shutdown(socket.SHUT_RDWR)
+            transports[2]._sock.close()
+            assert wait_until(lambda: 2 in hub._excluded)
+            threads = [threading.Thread(target=contribute, args=(rank, rank))
+                       for rank in (0, 1)]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join(timeout=10)
+            assert results[0] == results[1] == 10 + 0 + 1
+        finally:
+            shutdown(hub, transports)
+
+    def test_late_contribution_from_excluded_rank_dropped_without_leak(self):
+        """A slow-but-alive rank marked lost by the heartbeat monitor: its
+        late contribution must not resurrect a completed op (pending-entry
+        leak) — and it still receives the survivors' result."""
+        import threading
+        hub = Hub(3, heartbeat_timeout=0.3)
+        transports = [
+            TcpTransport(hub.address, 0, 3, heartbeat_interval=0.05),
+            TcpTransport(hub.address, 1, 3, heartbeat_interval=0.05),
+            TcpTransport(hub.address, 2, 3),   # never heartbeats -> 'lost'
+        ]
+        try:
+            assert wait_until(lambda: len(hub._clients) == 3)
+            assert wait_until(lambda: 2 in hub._excluded, timeout=5)
+            results = {}
+
+            def contribute(rank):
+                results[rank] = transports[rank].allreduce(rank, op='sum',
+                                                           timeout=10)
+
+            threads = [threading.Thread(target=contribute, args=(rank,))
+                       for rank in (0, 1)]
+            for thread in threads:
+                thread.start()
+            for thread in threads:
+                thread.join(timeout=10)
+            assert results == {0: 1, 1: 1}
+            # the excluded rank contributes late: dropped, no pending leak,
+            # but the stored result still answers its call
+            results[2] = transports[2].allreduce(2, op='sum', timeout=10)
+            assert results[2] == 1
+            assert wait_until(lambda: not hub._pending)
+        finally:
+            shutdown(hub, transports)
+
+    def test_observe_policy_logs_and_continues(self, caplog):
+        import logging
+        from tpusystem.parallel.multihost import WorkerJoined, WorkerLost
+        from tpusystem.parallel.recovery import recovery_consumer
+        consumer = recovery_consumer('observe')
+        with caplog.at_level(logging.INFO, logger='tpusystem.recovery'):
+            consumer.consume(WorkerLost(rank=3, last_seen=1.0))
+            consumer.consume(WorkerJoined(rank=3))
+        assert 'worker 3 lost' in caplog.text
+        assert 'worker 3 joined' in caplog.text
+
+    def test_unknown_policy_rejected(self):
+        from tpusystem.parallel.recovery import recovery_consumer
+        with pytest.raises(ValueError):
+            recovery_consumer('retry')

@@ -7,6 +7,8 @@ from tpusystem.parallel.multihost import (
     World, WorkerJoined, WorkerLost, agree, connect, world,
 )
 from tpusystem.parallel.pipeline import PipelineParallel, pipeline_apply
+from tpusystem.parallel.recovery import (LOST_WORKER_EXIT, WorkerLostError,
+                                         recovery_consumer)
 from tpusystem.parallel.sharding import (
     DataParallel, FullyShardedDataParallel, ShardingPolicy, TensorParallel,
 )
@@ -17,4 +19,5 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE',
            'World', 'world', 'connect', 'agree', 'Hub', 'Loopback',
            'TcpTransport', 'DistributedProducer', 'DistributedPublisher',
-           'WorkerLost', 'WorkerJoined']
+           'WorkerLost', 'WorkerJoined',
+           'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT']

@@ -173,6 +173,9 @@ class Hub:
         self._pending: dict[tuple, list] = {}
         self._last_seen: dict[int, float] = {}
         self._lost: set[int] = set()
+        # ranks out of the collective quota for good (crash, timeout, bye);
+        # see _live() for why this set only grows
+        self._excluded: set[int] = set()
         self._closed = threading.Event()
         self._threads = [threading.Thread(target=self._accept_loop, daemon=True)]
         if heartbeat_timeout:
@@ -199,6 +202,7 @@ class Hub:
                 self._clients[rank] = sock
                 self._last_seen[rank] = time.monotonic()
                 self._lost.discard(rank)     # a rejoining worker is alive
+                # NOT removed from _excluded: see _live()
             self._fanout(('joined', rank), exclude=rank)
             threading.Thread(target=self._client_loop, args=(rank, sock),
                              daemon=True).start()
@@ -221,9 +225,13 @@ class Hub:
                                and not self._closed.is_set())
                     if crashed:
                         self._lost.add(rank)
+                    self._excluded.add(rank)
                 sock.close()
                 if crashed:
                     self._fanout(('lost', rank, last_seen))
+                # either way the rank can no longer contribute: complete
+                # collectives that were only waiting on it
+                self._complete_satisfied()
                 return
             with self._locks:
                 self._last_seen[rank] = time.monotonic()
@@ -236,16 +244,19 @@ class Hub:
             elif kind in ('reduce', 'gather'):
                 _, op_key, value = frame
                 with self._locks:
-                    values = self._pending.setdefault(op_key, [])
-                    values.append(value)
-                    done = len(values) >= self.size
+                    if rank in self._excluded:
+                        # a rank outside the quota (crashed-then-revived or
+                        # restarted) must not resurrect completed op_keys or
+                        # skew live ranks' sequence numbers: drop. It still
+                        # receives results, so its own call returns.
+                        continue
+                    values = self._pending.setdefault(op_key, {})
+                    values[rank] = value
+                    done = self._live() <= values.keys()
                     if done:
                         del self._pending[op_key]
                 if done:
-                    kind_name, op, _ = op_key
-                    result = (_REDUCERS[op](values) if kind_name == 'reduce'
-                              else values)
-                    self._fanout(('result', op_key, result))
+                    self._emit_result(op_key, values)
 
     def _monitor_loop(self) -> None:
         while not self._closed.wait(self.heartbeat_timeout / 4):
@@ -255,8 +266,45 @@ class Hub:
                          if now - seen > self.heartbeat_timeout
                          and rank not in self._lost]
                 self._lost.update(rank for rank, _ in stale)
+                self._excluded.update(rank for rank, _ in stale)
             for rank, seen in stale:
                 self._fanout(('lost', rank, seen))
+            if stale:
+                self._complete_satisfied()
+
+    def _live(self) -> set[int]:
+        """Ranks a collective must wait for. The quota only ever shrinks:
+        losing a host degrades collectives to the survivors (what lets the
+        'observe' recovery policy keep agreeing stops instead of
+        deadlocking), and a rank that left — crash, heartbeat timeout, or
+        graceful 'bye' — never counts again for this Hub's lifetime (a
+        restarted worker's op counters restart at 0, so its contributions
+        cannot line up with the survivors'; full re-admission is the
+        restart-resume cycle, :mod:`tpusystem.parallel.recovery`). It still
+        receives events and collective results. Caller holds the lock."""
+        return set(range(self.size)) - self._excluded
+
+    def _emit_result(self, op_key: tuple, values: dict[int, Any]) -> None:
+        # include every contribution received for this op — a rank that
+        # voted and then died still voted
+        contributions = [values[rank] for rank in sorted(values)]
+        kind_name, op, _ = op_key
+        result = (_REDUCERS[op](contributions) if kind_name == 'reduce'
+                  else contributions)
+        self._fanout(('result', op_key, result))
+
+    def _complete_satisfied(self) -> None:
+        """After a loss, pending collectives that were only waiting on the
+        departed rank complete with the contributions already received."""
+        with self._locks:
+            live = self._live()
+            ready = [(op_key, values)
+                     for op_key, values in self._pending.items()
+                     if live <= values.keys()]
+            for op_key, _ in ready:
+                del self._pending[op_key]
+        for op_key, values in ready:
+            self._emit_result(op_key, values)
 
     def _fanout(self, frame: tuple, exclude: int | None = None) -> None:
         with self._locks:

@@ -1,0 +1,82 @@
+"""Elastic recovery policy: worker loss as a domain event with a decision.
+
+The reference has no failure machinery beyond exceptions-as-events
+(SURVEY.md §5 "failure detection / elastic recovery — absent"). The TPU
+build's recovery story composes three existing pieces:
+
+1. **detect** — the control plane surfaces a crashed or silent host as a
+   :class:`~tpusystem.parallel.multihost.WorkerLost` event on every other
+   host (socket death immediately; heartbeat timeout otherwise).
+2. **decide** — the :func:`recovery_consumer` here turns that event into
+   an :class:`WorkerLostError` raised on the host loop at the next
+   ``runtime.sync()`` (remote events dispatch at drain time, so the error
+   unwinds the epoch loop, never a collective mid-step).
+3. **resume** — a TPU mesh cannot be resized live: recovery *is* restart.
+   The job exits, the scheduler relaunches it, and the compilation
+   pipeline's ``bring_epoch``/``restore_weights`` steps resume from the
+   last committed checkpoint by identity hash (SURVEY.md §3.5) — the same
+   path as an ordinary preemption.
+
+Typical wiring::
+
+    runtime.producer.register(recovery_consumer())
+    try:
+        for epoch in range(model.epoch, epochs):
+            service.handle('iterate', model, loaders, metrics)
+            runtime.sync()                  # WorkerLostError raises here
+    except WorkerLostError as loss:
+        repository.wait()                   # keep the last good checkpoint
+        raise SystemExit(LOST_WORKER_EXIT)  # scheduler restarts -> resume
+
+``policy='observe'`` logs instead of raising — for jobs that prefer to
+finish the epoch on the survivors' data shards and stop at the agreed
+early-stop point.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpusystem.parallel.multihost import WorkerJoined, WorkerLost
+from tpusystem.services.prodcon import Consumer
+
+logger = logging.getLogger('tpusystem.recovery')
+
+# conventional exit code a launcher can map to "restart me"
+LOST_WORKER_EXIT = 42
+
+
+class WorkerLostError(RuntimeError):
+    """A peer host died; the job should checkpoint-fence and restart."""
+
+    def __init__(self, rank: int, last_seen: float):
+        super().__init__(
+            f'worker {rank} lost (last heartbeat at t={last_seen:.1f}); '
+            'restart the job to resume from the last committed checkpoint')
+        self.rank = rank
+        self.last_seen = last_seen
+
+
+def recovery_consumer(policy: str = 'abort') -> Consumer:
+    """Consumer deciding what worker loss means for this job.
+
+    ``'abort'`` (default): raise :class:`WorkerLostError` from the drain
+    point — the restart-resume cycle above. ``'observe'``: log and carry
+    on (the survivors still agree any stop collectively).
+    """
+    if policy not in ('abort', 'observe'):
+        raise ValueError(f"policy must be 'abort' or 'observe', got {policy!r}")
+    consumer = Consumer('recovery')
+
+    @consumer.handler
+    def on_worker_lost(event: WorkerLost) -> None:
+        if policy == 'abort':
+            raise WorkerLostError(event.rank, event.last_seen)
+        logger.warning('worker %d lost (last seen t=%.1f); continuing',
+                       event.rank, event.last_seen)
+
+    @consumer.handler
+    def on_worker_joined(event: WorkerJoined) -> None:
+        logger.info('worker %d joined the control plane', event.rank)
+
+    return consumer
