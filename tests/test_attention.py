@@ -146,3 +146,26 @@ def test_gpt2_ring_attention_long_context_trains():
         return out
 
     np.testing.assert_allclose(losses(dense, False), losses(ringed, True), rtol=2e-4)
+
+
+def test_block_fitting_keeps_midsize_lengths_on_the_kernel():
+    """Defaults that do not divide the sequence shrink to the largest
+    lane-aligned divisor instead of silently dropping to the O(seq^2) XLA
+    path; unalignable lengths still fall back."""
+    from tpusystem.ops.pallas.flash import _block_sizes
+    assert _block_sizes(1024, 1024, 512, 1024) == (512, 1024)
+    assert _block_sizes(1536, 1536, 512, 1024) == (512, 768)
+    assert _block_sizes(768, 768, 512, 1024) == (384, 768)
+    assert _block_sizes(16, 16, 512, 1024) == (16, 16)   # tiny single block
+    assert _block_sizes(100, 100, 64, 64) is None        # not sublane-aligned
+    assert _block_sizes(200, 200, 512, 1024) is None
+
+
+def test_flash_matches_reference_at_shrunk_blocks():
+    """Parity at a mid-size length where the tile is auto-shrunk."""
+    rng = np.random.default_rng(11)
+    q = jnp.asarray(rng.normal(size=(1, 384, 2, 16)), jnp.float32)
+    out = flash_attention(q, q, q, causal=True, interpret=True)
+    reference = dot_product_attention(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(reference),
+                               atol=2e-5)

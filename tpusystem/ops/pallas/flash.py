@@ -178,10 +178,30 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _fit_block(seq: int, want: int, granule: int = LANES) -> int | None:
+    """Largest lane-aligned divisor of ``seq`` that is <= ``want``.
+
+    Keeps mid-size sequence lengths (768, 1536, ...) on the flash kernel
+    with a smaller tile instead of silently dropping to the O(seq^2) XLA
+    fallback when the requested tile does not divide them. Sequences at or
+    under one granule run as a single block; sequences that no aligned
+    tile divides return None (XLA fallback).
+    """
+    want = min(want, seq)
+    if seq <= granule:
+        # single block, if it tiles onto the sublanes; otherwise XLA
+        return seq if seq % 8 == 0 else None
+    best = None
+    for candidate in range(granule, want + 1, granule):
+        if seq % candidate == 0:
+            best = candidate
+    return best
+
+
 def _block_sizes(seq_q: int, seq_kv: int, block_q: int, block_kv: int):
-    block_q = min(block_q, seq_q)
-    block_kv = min(block_kv, seq_kv)
-    if seq_q % block_q or seq_kv % block_kv:
+    block_q = _fit_block(seq_q, block_q)
+    block_kv = _fit_block(seq_kv, block_kv)
+    if block_q is None or block_kv is None:
         return None
     return block_q, block_kv
 
@@ -290,7 +310,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(query, key, value, *, causal: bool = True,
                     scale: float | None = None,
-                    block_q: int = 256, block_kv: int = 512,
+                    block_q: int = 512, block_kv: int = 1024,
                     interpret: bool | None = None):
     """Flash attention over [batch, length, heads, head_dim] tensors.
 
