@@ -76,3 +76,21 @@ def test_epoch_loop_pattern_with_early_stop():
                 stopped_at = epoch
                 break
         assert stopped_at == 3
+
+
+def test_worker_loss_unwinds_through_sync():
+    """Runtime + recovery wiring: a WorkerLost queued from the transport
+    surfaces as WorkerLostError at the epoch-boundary sync(), on the host
+    loop thread — the restart-resume entry point."""
+    from tpusystem.parallel.multihost import WorkerLost
+    from tpusystem.parallel.recovery import WorkerLostError, recovery_consumer
+
+    runtime = Runtime()
+    try:
+        runtime.producer.register(recovery_consumer())
+        runtime.producer._inbox.put(WorkerLost(rank=2, last_seen=12.5))
+        with pytest.raises(WorkerLostError) as excinfo:
+            runtime.sync()
+        assert excinfo.value.rank == 2
+    finally:
+        runtime.close()
