@@ -108,3 +108,26 @@ def test_llama_head_param_path_unchanged():
     assert 'lm_head' in params and 'kernel' in params['lm_head']
     dim = params['lm_head']['kernel'].shape
     assert dim == (module.dim, module.vocab_size)
+
+
+def test_pipelined_gpt2_fused_loss_matches_logits_path():
+    """return_features on the pipelined variant: same loss as the full
+    logits path on the same stacked parameters (2-stage virtual mesh)."""
+    from tpusystem.models import GPT2Pipelined
+    from tpusystem.parallel import MeshSpec
+
+    mesh = MeshSpec(stage=2).build(jax.devices()[:2])
+    common = dict(vocab_size=256, layers=4, dim=32, heads=4, max_seq=64,
+                  dtype='float32', microbatches=2, remat=False, mesh=mesh)
+    logits_model = GPT2Pipelined(**common)
+    fused_model = GPT2Pipelined(**common, return_features=True)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, 256, (2, 16)), jnp.int32)
+    variables = logits_model.init(jax.random.PRNGKey(0), tokens)
+
+    logits = logits_model.apply(variables, tokens)
+    features = fused_model.apply(variables, tokens)
+    assert features[1].shape == (256, 32)            # tied [vocab, dim] table
+    baseline = NextTokenLoss()(logits, tokens)
+    chunked = ChunkedNextTokenLoss(chunks=4, tied=True)(features, tokens)
+    np.testing.assert_allclose(float(baseline), float(chunked), rtol=2e-5)

@@ -252,13 +252,15 @@ class GPT2Pipelined:
     def __init__(self, vocab_size: int = 50257, layers: int = 12,
                  dim: int = 768, heads: int = 12, max_seq: int = 1024,
                  mlp_ratio: int = 4, dtype: str = 'bfloat16',
-                 microbatches: int = 4, remat: bool = True, mesh=None):
+                 microbatches: int = 4, remat: bool = True, mesh=None,
+                 return_features: bool = False):
         if mesh is None:
             raise ValueError('GPT2Pipelined needs a mesh with a stage axis')
         self.vocab_size, self.layers, self.dim = vocab_size, layers, dim
         self.heads, self.max_seq, self.mlp_ratio = heads, max_seq, mlp_ratio
         self.dtype = dtype
         self.microbatches, self.remat, self.mesh = microbatches, remat, mesh
+        self.return_features = return_features
         self.block = Block(heads, mlp_ratio, 0.0, jnp.dtype(dtype))
 
     def __call__(self, tokens, train: bool = False):
@@ -291,6 +293,10 @@ class GPT2Pipelined:
         hidden = nn.LayerNorm(dtype=jnp.float32).apply(
             {'params': params['ln_f']}, hidden.astype(jnp.float32))
         table = params['wte']['embedding'].astype(jnp.dtype(self.dtype))
+        if self.return_features:
+            # fused-loss path (train.ChunkedNextTokenLoss): the criterion
+            # owns the head matmul, logits are never materialized
+            return hidden.astype(jnp.dtype(self.dtype)), table
         return head_logits(hidden, table, tied=True)
 
     def _block_fn(self):
