@@ -266,7 +266,8 @@ def main(epochs: int = 3, full: bool = False) -> None:
     dataset = SyntheticTokens(samples=64 * batch, sequence_length=sequence,
                               vocab_size=min(network.vocab_size, 256))
     holdout = SyntheticTokens(samples=8 * batch, sequence_length=sequence,
-                              vocab_size=min(network.vocab_size, 256), seed=1)
+                              vocab_size=min(network.vocab_size, 256),
+                              train=False)   # same bigram table, unseen draws
     loaders = {'train': Loader(dataset, batch_size=batch, shuffle=True, seed=0),
                'evaluation': Loader(holdout, batch_size=batch)}
     metrics = LMMetrics()

@@ -39,6 +39,10 @@ def test_pretrains_and_resumes(lm_main, capsys):
     losses = [row.value for row in rows
               if row.name == 'loss' and row.phase == 'train']
     assert losses[-1] < losses[0]     # bigram structure is learnable
+    evals = [row.value for row in rows
+             if row.name == 'loss' and row.phase == 'evaluation']
+    # holdout shares the bigram table (train=False): learning generalizes
+    assert evals[-1] < evals[0]
     store.close()
 
     lm_main.main(epochs=3)

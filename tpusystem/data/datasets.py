@@ -33,13 +33,21 @@ class SyntheticDigits(ArrayDataset):
 
 @register
 class SyntheticTokens(ArrayDataset):
-    """Language-model token streams with learnable bigram structure."""
+    """Language-model token streams with learnable bigram structure.
+
+    The sparse bigram transition table derives from ``seed`` alone and is
+    shared across splits (like :class:`SyntheticDigits` prototypes), so a
+    ``train=False`` holdout draws *different sequences from the same
+    distribution* — held-out perplexity is meaningful."""
 
     def __init__(self, samples: int = 1024, sequence_length: int = 128,
-                 vocab_size: int = 256, seed: int = 0):
-        rng = np.random.default_rng(seed)
-        # fixed sparse bigram transition table -> sequences are predictable
-        table = rng.integers(0, vocab_size, size=(vocab_size, 4))
+                 vocab_size: int = 256, seed: int = 0, train: bool = True):
+        table_rng = np.random.default_rng(seed)      # shared across splits
+        table = table_rng.integers(0, vocab_size, size=(vocab_size, 4))
+        # train continues the table stream (a fresh default_rng(seed) would
+        # replay the table draw bit-for-bit into tokens[:, 0]); the holdout
+        # seeds off-stream for independent draws from the same table
+        rng = table_rng if train else np.random.default_rng(seed + 1)
         tokens = np.empty((samples, sequence_length), dtype=np.int32)
         tokens[:, 0] = rng.integers(0, vocab_size, size=samples)
         choices = rng.integers(0, 4, size=(samples, sequence_length))
