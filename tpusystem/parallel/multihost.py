@@ -48,7 +48,7 @@ import struct
 import threading
 import time
 from collections.abc import Callable
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any
 
 from tpusystem.services.prodcon import Consumer, Producer, event

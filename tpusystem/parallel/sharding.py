@@ -27,7 +27,7 @@ from typing import Any, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from tpusystem.parallel.mesh import DATA, FSDP, MODEL
+from tpusystem.parallel.mesh import FSDP
 from tpusystem.registry import register
 
 Rules = Sequence[tuple[str, PartitionSpec]]
