@@ -156,3 +156,74 @@ def test_combined_dp_fsdp_tp_mesh(digits_batch):
         rules=[(r'Dense_0/kernel$', P(None, 'model'))], fsdp=True, fsdp_min_size=64)
     combined_losses, _ = _train_losses(mesh, policy, digits_batch)
     np.testing.assert_allclose(single_losses, combined_losses, rtol=2e-5)
+
+
+class TestCollectiveVocabulary:
+    """The shard_map collective wrappers — the data-plane vocabulary every
+    explicit kernel (ring attention, pipeline, MoE) builds on."""
+
+    def _mapped(self, fn, n=4):
+        from tpusystem.parallel import MeshSpec
+        import jax
+        from jax.sharding import PartitionSpec as P
+        mesh = MeshSpec(data=n).build(jax.devices()[:n])
+        return jax.shard_map(fn, mesh=mesh, in_specs=P('data'),
+                             out_specs=P('data'))
+
+    def test_reductions_and_gather(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from tpusystem.parallel import (all_gather, all_reduce_mean,
+                                        all_reduce_sum)
+        values = jnp.arange(4.0)
+
+        total = self._mapped(lambda x: all_reduce_sum(x, 'data'))(values)
+        np.testing.assert_array_equal(np.asarray(total), [6.0] * 4)
+        mean = self._mapped(lambda x: all_reduce_mean(x, 'data'))(values)
+        np.testing.assert_array_equal(np.asarray(mean), [1.5] * 4)
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from tpusystem.parallel import MeshSpec
+        mesh = MeshSpec(data=4).build(jax.devices()[:4])
+        gathered = jax.shard_map(
+            lambda x: all_gather(x, 'data'), mesh=mesh,
+            in_specs=P('data'), out_specs=P('data'))(values)
+        # every shard holds the full gathered array
+        np.testing.assert_array_equal(np.asarray(gathered),
+                                      list(range(4)) * 4)
+
+    def test_reduce_scatter_and_ring_shift(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from tpusystem.parallel import reduce_scatter, ring_shift
+        values = jnp.ones((4, 4))   # each shard holds a [1, 4] row... -> [4]
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from tpusystem.parallel import MeshSpec
+        mesh = MeshSpec(data=4).build(jax.devices()[:4])
+        scattered = jax.shard_map(
+            lambda x: reduce_scatter(x[0], 'data'), mesh=mesh,
+            in_specs=P('data'), out_specs=P('data'))(values)
+        np.testing.assert_array_equal(np.asarray(scattered), [4.0] * 4)
+
+        shifted = self._mapped(lambda x: ring_shift(x, 'data'))(jnp.arange(4.0))
+        np.testing.assert_array_equal(np.asarray(shifted), [3, 0, 1, 2])
+        back = self._mapped(
+            lambda x: ring_shift(x, 'data', reverse=True))(jnp.arange(4.0))
+        np.testing.assert_array_equal(np.asarray(back), [1, 2, 3, 0])
+
+    def test_all_to_all_shard_transpose(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import PartitionSpec as P
+        from tpusystem.parallel import MeshSpec, all_to_all
+        mesh = MeshSpec(data=2).build(jax.devices()[:2])
+        data = jnp.arange(8.0).reshape(2, 4)   # each shard [1, 4]
+        swapped = jax.shard_map(
+            lambda x: all_to_all(x, 'data', split_dimension=1,
+                                 concat_dimension=0),
+            mesh=mesh, in_specs=P('data'), out_specs=P('data'))(data)
+        # shard 0 keeps its first half and receives shard 1's first half
+        np.testing.assert_array_equal(
+            np.asarray(swapped), [[0, 1], [4, 5], [2, 3], [6, 7]])

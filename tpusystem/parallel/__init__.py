@@ -6,6 +6,10 @@ from tpusystem.parallel.multihost import (
     DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
     World, WorkerJoined, WorkerLost, agree, connect, world,
 )
+from tpusystem.parallel.collectives import (
+    all_gather, all_reduce_mean, all_reduce_sum, all_to_all, axis_index,
+    axis_size, reduce_scatter, ring_shift,
+)
 from tpusystem.parallel.pipeline import PipelineParallel, pipeline_apply
 from tpusystem.parallel.recovery import (LOST_WORKER_EXIT, WorkerLostError,
                                          recovery_consumer)
@@ -20,4 +24,7 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'World', 'world', 'connect', 'agree', 'Hub', 'Loopback',
            'TcpTransport', 'DistributedProducer', 'DistributedPublisher',
            'WorkerLost', 'WorkerJoined',
-           'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT']
+           'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT',
+           'all_reduce_sum', 'all_reduce_mean', 'all_gather',
+           'reduce_scatter', 'all_to_all', 'ring_shift', 'axis_index',
+           'axis_size']
