@@ -162,13 +162,13 @@ class TestCollectiveVocabulary:
     """The shard_map collective wrappers — the data-plane vocabulary every
     explicit kernel (ring attention, pipeline, MoE) builds on."""
 
-    def _mapped(self, fn, n=4):
-        from tpusystem.parallel import MeshSpec
+    def _mapped(self, fn, n=4, out_spec=None):
         import jax
         from jax.sharding import PartitionSpec as P
+        from tpusystem.parallel import MeshSpec
         mesh = MeshSpec(data=n).build(jax.devices()[:n])
         return jax.shard_map(fn, mesh=mesh, in_specs=P('data'),
-                             out_specs=P('data'))
+                             out_specs=P('data') if out_spec is None else out_spec)
 
     def test_reductions_and_gather(self):
         import jax.numpy as jnp
@@ -181,13 +181,7 @@ class TestCollectiveVocabulary:
         np.testing.assert_array_equal(np.asarray(total), [6.0] * 4)
         mean = self._mapped(lambda x: all_reduce_mean(x, 'data'))(values)
         np.testing.assert_array_equal(np.asarray(mean), [1.5] * 4)
-        import jax
-        from jax.sharding import PartitionSpec as P
-        from tpusystem.parallel import MeshSpec
-        mesh = MeshSpec(data=4).build(jax.devices()[:4])
-        gathered = jax.shard_map(
-            lambda x: all_gather(x, 'data'), mesh=mesh,
-            in_specs=P('data'), out_specs=P('data'))(values)
+        gathered = self._mapped(lambda x: all_gather(x, 'data'))(values)
         # every shard holds the full gathered array
         np.testing.assert_array_equal(np.asarray(gathered),
                                       list(range(4)) * 4)
@@ -196,14 +190,9 @@ class TestCollectiveVocabulary:
         import jax.numpy as jnp
         import numpy as np
         from tpusystem.parallel import reduce_scatter, ring_shift
-        values = jnp.ones((4, 4))   # each shard holds a [1, 4] row... -> [4]
-        import jax
-        from jax.sharding import PartitionSpec as P
-        from tpusystem.parallel import MeshSpec
-        mesh = MeshSpec(data=4).build(jax.devices()[:4])
-        scattered = jax.shard_map(
-            lambda x: reduce_scatter(x[0], 'data'), mesh=mesh,
-            in_specs=P('data'), out_specs=P('data'))(values)
+
+        scattered = self._mapped(
+            lambda x: reduce_scatter(x[0], 'data'))(jnp.ones((4, 4)))
         np.testing.assert_array_equal(np.asarray(scattered), [4.0] * 4)
 
         shifted = self._mapped(lambda x: ring_shift(x, 'data'))(jnp.arange(4.0))
@@ -213,17 +202,14 @@ class TestCollectiveVocabulary:
         np.testing.assert_array_equal(np.asarray(back), [1, 2, 3, 0])
 
     def test_all_to_all_shard_transpose(self):
-        import jax
         import jax.numpy as jnp
         import numpy as np
-        from jax.sharding import PartitionSpec as P
-        from tpusystem.parallel import MeshSpec, all_to_all
-        mesh = MeshSpec(data=2).build(jax.devices()[:2])
+        from tpusystem.parallel import all_to_all
+
         data = jnp.arange(8.0).reshape(2, 4)   # each shard [1, 4]
-        swapped = jax.shard_map(
+        swapped = self._mapped(
             lambda x: all_to_all(x, 'data', split_dimension=1,
-                                 concat_dimension=0),
-            mesh=mesh, in_specs=P('data'), out_specs=P('data'))(data)
+                                 concat_dimension=0), n=2)(data)
         # shard 0 keeps its first half and receives shard 1's first half
         np.testing.assert_array_equal(
             np.asarray(swapped), [[0, 1], [4, 5], [2, 3], [6, 7]])

@@ -25,6 +25,7 @@ from inspect import signature
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import optax
 
 from tpusystem.train.state import TrainState
@@ -53,12 +54,21 @@ def flax_apply(module) -> ApplyFn:
 
 
 def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
-                     *, jit: bool = True):
+                     *, accumulate: int = 1, jit: bool = True):
     """Build ``step(state, inputs, targets) -> (state, (outputs, loss))``.
 
     ``optimizer`` is a :class:`tpusystem.train.optim.Optimizer` or a raw
     ``optax.GradientTransformation``. The returned step donates ``state``:
     callers must treat the passed-in state as consumed.
+
+    ``accumulate=N`` splits the leading batch dimension into N sequential
+    microbatches inside the step (``lax.scan``), averaging gradients
+    before the single optimizer update — the activation-memory lever when
+    the target global batch does not fit (grads add one params-sized
+    buffer; activations shrink by N). Per-example-mean losses make the
+    result equal to the full-batch step up to float reordering. With
+    accumulation, the returned ``outputs`` are the final microbatch's and
+    ``loss`` is the mean over microbatches.
 
     For activation rematerialisation use per-layer checkpointing at the
     model level (e.g. ``GPT2(remat=True)``) — whole-forward checkpointing
@@ -66,14 +76,48 @@ def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
     """
     transform = optimizer.transform() if hasattr(optimizer, 'transform') else optimizer
 
+    def objective(params, inputs, targets, dropout_rng):
+        outputs = apply_fn(params, inputs, dropout_rng, True)
+        return criterion(outputs, targets), outputs
+
     def step(state: TrainState, inputs, targets):
         state, dropout_rng = state.next_rng()
+        if accumulate == 1:
+            (loss, outputs), grads = jax.value_and_grad(
+                objective, has_aux=True)(state.params, inputs, targets,
+                                         dropout_rng)
+        else:
+            batch = jax.tree.leaves(inputs)[0].shape[0]
+            assert batch % accumulate == 0, (
+                f'batch {batch} not divisible by accumulate={accumulate}')
+            split = lambda leaf: leaf.reshape(
+                (accumulate, batch // accumulate) + leaf.shape[1:])
+            micro = (jax.tree.map(split, inputs), jax.tree.map(split, targets),
+                     jax.random.split(dropout_rng, accumulate))
+            params = state.params
 
-        def objective(params):
-            outputs = apply_fn(params, inputs, dropout_rng, True)
-            return criterion(outputs, targets), outputs
+            def one(carry, xs):
+                grads_acc, loss_acc, _ = carry
+                micro_inputs, micro_targets, rng = xs
+                (loss, outputs), grads = jax.value_and_grad(
+                    objective, has_aux=True)(params, micro_inputs,
+                                             micro_targets, rng)
+                # outputs ride the CARRY (last microbatch wins): stacking
+                # them as scan ys would materialize the full-batch outputs
+                # buffer this feature exists to avoid
+                return (jax.tree.map(jnp.add, grads_acc, grads),
+                        loss_acc + loss, outputs), None
 
-        (loss, outputs), grads = jax.value_and_grad(objective, has_aux=True)(state.params)
+            first = jax.tree.map(lambda leaf: leaf[0], micro)
+            output_shapes = jax.eval_shape(
+                lambda *xs: objective(params, *xs)[1], *first[:2], first[2])
+            empty = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), output_shapes)
+            zeros = jax.tree.map(jnp.zeros_like, params)
+            (grads, loss_sum, outputs), _ = jax.lax.scan(
+                one, (zeros, 0.0, empty), micro)
+            grads = jax.tree.map(lambda g: g / accumulate, grads)
+            loss = loss_sum / accumulate
         updates, opt_state = transform.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
