@@ -204,10 +204,17 @@ class GPT2(nn.Module):
                 return (features, table), aux
             return features, table
         logits = head_logits(hidden.astype(compute_dtype), table, tied=True)
-        if self.moe_experts:
+        if self.moe_experts and not self.decode:
             # arity is fixed by configuration, not by which layers happened
             # to be MoE, so the WithAuxLoss pairing can't be broken by a
-            # (layers, moe_every) combination that selects no layer
+            # (layers, moe_every) combination that selects no layer. In
+            # decode mode the aux (router-balance) term is meaningless —
+            # logits only, so generation works on MoE models too. Caveat:
+            # expert capacity derives from the call's token count, so a
+            # decode step (batch tokens) effectively never drops, while a
+            # training-shaped forward (batch*seq tokens) may — decode
+            # matches it exactly only where the training forward drops
+            # nothing (capacity-based MoE's standard decode asymmetry).
             aux = jnp.mean(jnp.stack(aux_losses)) if aux_losses else jnp.float32(0)
             return logits, aux
         return logits

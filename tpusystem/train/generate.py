@@ -21,13 +21,10 @@ import jax.numpy as jnp
 
 def _decoder(module):
     """Clone the module into decode mode: xla attention (flash/ring make no
-    sense one token at a time), no dropout, logits output. The mesh field
-    is dropped too — the decode path never reads it, and an unhashable live
-    mesh would defeat the compiled-program cache."""
-    if getattr(module, 'moe_experts', 0):
-        raise NotImplementedError(
-            'KV-cache decoding is not implemented for MoE-configured models '
-            '(the aux-loss output and expert dispatch are training-shaped)')
+    sense one token at a time), no dropout, logits output (MoE models drop
+    their aux/router term — it only exists for the training loss). The
+    mesh field is dropped too — the decode path never reads it, and an
+    unhashable live mesh would defeat the compiled-program cache."""
     updates: dict = {'decode': True}
     for field, value in (('attention', 'xla'), ('dropout', 0.0),
                          ('return_features', False), ('remat', False),
