@@ -17,14 +17,13 @@ from __future__ import annotations
 import contextlib
 import logging
 import pathlib
-import sys
 
 import jax
 import jax.numpy as jnp
 
 from tpusystem import Aggregate, Compiler, Depends, Runtime
 from tpusystem.checkpoint import Repository
-from tpusystem.data import Loader, SyntheticTokens
+from tpusystem.data import Loader, MemmapTokens, SyntheticTokens
 from tpusystem.depends import Provider
 from tpusystem.models import GPT2, gpt2_tiny
 from tpusystem.observe import checkpoint_consumer, logging_consumer, tracking
@@ -50,7 +49,7 @@ class LanguageModel(Aggregate):
     """Network + criterion + optimizer as one identity-bearing unit; the
     math is two jitted steps over an FSDP-sharded TrainState."""
 
-    def __init__(self, network, criterion, optimizer):
+    def __init__(self, network, criterion, optimizer, accumulate: int = 1):
         super().__init__()
         self.network = network
         self.criterion = criterion
@@ -59,7 +58,8 @@ class LanguageModel(Aggregate):
         self.mesh = None
         self.epoch = 0
         apply_fn = flax_apply(network)
-        self._train_step = build_train_step(apply_fn, criterion, optimizer)
+        self._train_step = build_train_step(apply_fn, criterion, optimizer,
+                                            accumulate=accumulate)
         self._eval_step = build_eval_step(apply_fn, criterion)
 
     @property
@@ -133,6 +133,12 @@ def sample_tokens():
     return jnp.zeros((1, 8), jnp.int32)
 
 
+def accumulate() -> int:
+    """Gradient-accumulation microsteps (override at the composition
+    root when the target global batch does not fit)."""
+    return 1
+
+
 def models():
     raise NotImplementedError('override the models store dependency')
 
@@ -146,8 +152,9 @@ def experiment() -> str:
 
 
 @compiler.step
-def build(network, criterion, optimizer) -> LanguageModel:
-    return LanguageModel(network, criterion, optimizer)
+def build(network, criterion, optimizer,
+          microsteps: int = Depends(accumulate)) -> LanguageModel:
+    return LanguageModel(network, criterion, optimizer, accumulate=microsteps)
 
 
 @compiler.step
@@ -226,7 +233,8 @@ def validate(model, loader, metrics) -> None:
 # --------------------------------------------------------------------------
 # composition root
 
-def main(epochs: int = 3, full: bool = False) -> None:
+def main(epochs: int = 3, full: bool = False, corpus: str | None = None,
+         holdout_corpus: str | None = None, microsteps: int = 1) -> None:
     global producer
     logging.basicConfig(level=logging.INFO, format='%(message)s', force=True)
     for noisy in ('orbax', 'absl', 'jax'):
@@ -253,6 +261,7 @@ def main(epochs: int = 3, full: bool = False) -> None:
 
     provider.override(models, lambda: DocumentModels(store))
     provider.override(repository, lambda: weights)
+    provider.override(accumulate, lambda: microsteps)
 
     if full:
         network = GPT2(vocab_size=50304, dropout=0.0, return_features=True)
@@ -263,11 +272,20 @@ def main(epochs: int = 3, full: bool = False) -> None:
     model = compiler.compile(network, ChunkedNextTokenLoss(chunks=8),
                              AdamW(lr=3e-4, grad_clip=1.0))
 
-    dataset = SyntheticTokens(samples=64 * batch, sequence_length=sequence,
-                              vocab_size=min(network.vocab_size, 256))
-    holdout = SyntheticTokens(samples=8 * batch, sequence_length=sequence,
-                              vocab_size=min(network.vocab_size, 256),
-                              train=False)   # same bigram table, unseen draws
+    if corpus:
+        # MemmapTokens windows are sequence_length + 1 (the loss shifts
+        # inputs/targets out of one tensor): size them to the model's cap
+        dataset = MemmapTokens(corpus, sequence_length=sequence - 1)
+        # evaluate on a separate file, or reuse the training corpus when
+        # none is given (then eval loss is training-distribution loss)
+        holdout = (MemmapTokens(holdout_corpus, sequence_length=sequence - 1)
+                   if holdout_corpus else dataset)
+    else:
+        dataset = SyntheticTokens(samples=64 * batch, sequence_length=sequence,
+                                  vocab_size=min(network.vocab_size, 256))
+        holdout = SyntheticTokens(samples=8 * batch, sequence_length=sequence,
+                                  vocab_size=min(network.vocab_size, 256),
+                                  train=False)  # same bigram table, unseen draws
     loaders = {'train': Loader(dataset, batch_size=batch, shuffle=True, seed=0),
                'evaluation': Loader(holdout, batch_size=batch)}
     metrics = LMMetrics()
@@ -291,5 +309,22 @@ def main(epochs: int = 3, full: bool = False) -> None:
 
 
 if __name__ == '__main__':
-    arguments = [argument for argument in sys.argv[1:] if argument != '--full']
-    main(int(arguments[0]) if arguments else 3, full='--full' in sys.argv)
+    import argparse
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('epochs', nargs='?', type=int, default=3)
+    parser.add_argument('--full', action='store_true',
+                        help='125M preset instead of tiny')
+    parser.add_argument('--corpus', help='flat binary token file '
+                        '(MemmapTokens layout) instead of synthetic data')
+    parser.add_argument('--holdout', help='separate corpus file for eval')
+    def positive(value: str) -> int:
+        steps = int(value)
+        if steps < 1:
+            raise argparse.ArgumentTypeError('must be >= 1')
+        return steps
+
+    parser.add_argument('--accumulate', type=positive, default=1,
+                        help='gradient-accumulation microsteps per batch')
+    args = parser.parse_args()
+    main(args.epochs, full=args.full, corpus=args.corpus,
+         holdout_corpus=args.holdout, microsteps=args.accumulate)

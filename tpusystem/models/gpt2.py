@@ -195,16 +195,19 @@ class GPT2(nn.Module):
         # here would put ~30% of the model's FLOPs on the slow path — and
         # the f32 logits keep the softmax/loss numerically stable.
         table = token_embedding.embedding.astype(compute_dtype)
+        # MoE aux (router balance) exists only for the training loss; in
+        # decode mode every output branch is aux-free
+        emit_aux = self.moe_experts and not self.decode
         if self.return_features:
             # fused-head path: the criterion owns the head matmul and never
             # materializes the [batch*seq, vocab] f32 logits tensor
             features = hidden.astype(compute_dtype)
-            if self.moe_experts:
+            if emit_aux:
                 aux = jnp.mean(jnp.stack(aux_losses)) if aux_losses else jnp.float32(0)
                 return (features, table), aux
             return features, table
         logits = head_logits(hidden.astype(compute_dtype), table, tied=True)
-        if self.moe_experts and not self.decode:
+        if emit_aux:
             # arity is fixed by configuration, not by which layers happened
             # to be MoE, so the WithAuxLoss pairing can't be broken by a
             # (layers, moe_every) combination that selects no layer. In
