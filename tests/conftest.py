@@ -6,18 +6,12 @@ the strategy the reference lacks entirely (SURVEY.md §4: reference tests are
 single-process CPU-only; we add simulated-multi-device coverage).
 """
 
-import os
-
-flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in flags:
-    os.environ['XLA_FLAGS'] = (flags + ' --xla_force_host_platform_device_count=8').strip()
-
-import jax
-
 # The environment tunnels a real TPU chip and its plugin *prepends* itself to
-# jax_platforms (config becomes 'axon,cpu'), so neither JAX_PLATFORMS=cpu in
-# the env nor setdefault wins. Forcing the config after import does.
-jax.config.update('jax_platforms', 'cpu')
+# jax_platforms (config becomes 'axon,cpu'), so JAX_PLATFORMS=cpu in the env
+# does not win; force_host_platform handles the env flag + config ordering.
+from tpusystem.parallel import force_host_platform
+
+force_host_platform(8)
 
 import pathlib
 import shutil

@@ -1,6 +1,7 @@
 from tpusystem.parallel.mesh import (
     AXES, DATA, EXPERT, FSDP, MODEL, SEQ, STAGE,
-    MeshSpec, batch_sharding, replicated, single_device_mesh,
+    MeshSpec, batch_sharding, force_host_platform, replicated,
+    single_device_mesh,
 )
 from tpusystem.parallel.multihost import (
     DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
@@ -18,6 +19,7 @@ from tpusystem.parallel.sharding import (
 )
 
 __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
+           'force_host_platform',
            'ShardingPolicy', 'DataParallel', 'FullyShardedDataParallel',
            'TensorParallel', 'PipelineParallel', 'pipeline_apply',
            'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE',

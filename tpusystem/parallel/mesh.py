@@ -37,6 +37,39 @@ DATA, FSDP, MODEL, SEQ, EXPERT, STAGE = 'data', 'fsdp', 'model', 'seq', 'expert'
 AXES = (DATA, FSDP, MODEL, SEQ, EXPERT, STAGE)
 
 
+def force_host_platform(n_devices: int = 8) -> None:
+    """Force JAX onto the host (CPU) platform with ``n_devices`` virtual chips.
+
+    The standard way to exercise mesh/collective code (DP/FSDP/TP/PP/SP/EP)
+    without TPU hardware: the test suite and ``dryrun_multichip`` both run on
+    a virtual CPU mesh set up by this call. Setting ``JAX_PLATFORMS=cpu`` in
+    the environment is NOT enough when an accelerator plugin is installed
+    (plugins prepend themselves to ``jax_platforms``); forcing the config
+    after import wins.
+
+    Must be called before the first JAX backend initialization in the
+    process — XLA reads ``--xla_force_host_platform_device_count`` once, at
+    backend creation. Raises RuntimeError (rather than leaving a silently
+    single-device mesh) when called too late.
+    """
+    import os
+    flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags +
+            f' --xla_force_host_platform_device_count={n_devices}').strip()
+    jax.config.update('jax_platforms', 'cpu')
+    have = len(jax.devices('cpu'))
+    if have < n_devices:
+        raise RuntimeError(
+            f'need {n_devices} virtual CPU devices but found {have}: a JAX '
+            f'backend was already initialized in this process, so '
+            f'--xla_force_host_platform_device_count cannot take effect. '
+            f'Call force_host_platform() before any JAX operation, or run '
+            f'in a fresh process with XLA_FLAGS='
+            f'--xla_force_host_platform_device_count={n_devices}.')
+
+
 @register
 class MeshSpec:
     """Declarative mesh layout: axis name -> size.
