@@ -53,21 +53,27 @@ def force_host_platform(n_devices: int = 8) -> None:
     single-device mesh) when called too late.
     """
     import os
+    import re
+    flag = '--xla_force_host_platform_device_count'
     flags = os.environ.get('XLA_FLAGS', '')
-    if '--xla_force_host_platform_device_count' not in flags:
-        os.environ['XLA_FLAGS'] = (
-            flags +
-            f' --xla_force_host_platform_device_count={n_devices}').strip()
+    if flag in flags:
+        # Replace a stale preset count (e.g. from the caller's environment)
+        # rather than silently keeping it when it is smaller than requested.
+        current = re.search(rf'{flag}=(\d+)', flags)
+        if current and int(current.group(1)) < n_devices:
+            flags = re.sub(rf'{flag}=\d+', f'{flag}={n_devices}', flags)
+            os.environ['XLA_FLAGS'] = flags
+    else:
+        os.environ['XLA_FLAGS'] = (flags + f' {flag}={n_devices}').strip()
     jax.config.update('jax_platforms', 'cpu')
     have = len(jax.devices('cpu'))
     if have < n_devices:
         raise RuntimeError(
             f'need {n_devices} virtual CPU devices but found {have}: a JAX '
             f'backend was already initialized in this process, so '
-            f'--xla_force_host_platform_device_count cannot take effect. '
+            f'{flag} cannot take effect. '
             f'Call force_host_platform() before any JAX operation, or run '
-            f'in a fresh process with XLA_FLAGS='
-            f'--xla_force_host_platform_device_count={n_devices}.')
+            f'in a fresh process with XLA_FLAGS={flag}={n_devices}.')
 
 
 @register
