@@ -44,9 +44,12 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
 
     ``'xla'`` routes to :func:`dot_product_attention` (GSPMD-shardable,
     GQA-aware, optional probability dropout). ``'flash'`` is the Pallas
-    O(seq)-memory kernel; ``'ring'``/``'ulysses'`` are the sequence-parallel
-    variants (need ``mesh`` with a seq axis). Non-xla kernels take full-head
-    tensors, so grouped KV is repeated up to the query head count first.
+    O(seq)-memory kernel — single-shard when ``mesh`` is None, composed
+    with DP/FSDP/TP via ``shard_map`` over the (data, fsdp) x model axes
+    when a mesh is passed. ``'ring'``/``'ulysses'`` are the
+    sequence-parallel variants (need ``mesh`` with a seq axis); they take
+    full-head tensors, so grouped KV is repeated up to the query head
+    count first.
     """
     if kernel == 'xla':
         return dot_product_attention(query, key, value, causal=causal,
@@ -55,7 +58,11 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
         raise ValueError("attention-probability dropout is only implemented "
                          f"on the 'xla' kernel, not {kernel!r}")
     if kernel == 'flash':  # flash broadcasts GQA heads itself
-        from tpusystem.ops.pallas.flash import flash_attention
+        from tpusystem.ops.pallas.flash import (flash_attention,
+                                                sharded_flash_attention)
+        if mesh is not None:  # compose with DP/FSDP/TP via shard_map
+            return sharded_flash_attention(query, key, value, mesh,
+                                           causal=causal)
         return flash_attention(query, key, value, causal=causal)
     if kernel in ('ring', 'ulysses'):
         from tpusystem.ops.ring import ring_self_attention

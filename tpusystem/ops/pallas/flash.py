@@ -19,10 +19,12 @@ Kernel shape notes (see /opt/skills/guides/pallas_guide.md):
   (dq kernel sweeps kv; dk/dv kernel sweeps q innermost).
 
 SPMD note: a ``pallas_call`` is a manual computation that GSPMD cannot
-auto-partition, so this kernel is for **single-device-per-shard** contexts:
-one chip, or inside ``shard_map`` (as the ring/Ulysses wrappers do). Under
-GSPMD policies (DP/FSDP/TP) use the ``'xla'`` attention kernel, which the
-partitioner shards freely.
+auto-partition, so the raw kernel runs **one device per shard**. To compose
+with GSPMD policies (DP/FSDP/TP), :func:`sharded_flash_attention` wraps the
+kernel in ``shard_map`` — attention is embarrassingly parallel over
+batch x heads, so batch shards over the (data, fsdp) axes and heads over
+the model axis, matching the Megatron-style TP rules the model families
+ship. ``attend(kernel='flash', mesh=...)`` routes there automatically.
 
 ``interpret=True`` runs the same kernels in interpreter mode for CPU tests.
 """
@@ -342,3 +344,46 @@ def flash_attention(query, key, value, *, causal: bool = True,
     out = _flash(to_bh(query), to_bh(key), to_bh(value),
                  causal, scale, block_q, block_kv, interpret)
     return out.reshape(batch, q_heads, seq_q, head_dim).transpose(0, 2, 1, 3)
+
+
+def sharded_flash_attention(query, key, value, mesh, *, causal: bool = True,
+                            scale: float | None = None):
+    """Flash attention composed with GSPMD policies via ``shard_map``.
+
+    Attention is embarrassingly parallel over batch x heads: batch shards
+    over the (data, fsdp) mesh axes and heads over the model axis — the
+    layout the TP partition rules already give the QKV projections — and
+    the Pallas kernel runs independently per shard. Differentiable (the
+    kernel's ``custom_vjp`` composes with ``shard_map``'s transpose).
+
+    Axes that do not divide the corresponding tensor dimension are left
+    replicated (e.g. ``module.init`` traces with batch 1). Under GQA the
+    KV-head axis shards over ``model`` when divisible; otherwise KV heads
+    are broadcast up to the query head count first.
+    """
+    from math import prod
+
+    from jax.sharding import PartitionSpec as P
+
+    from tpusystem.ops.attention import repeat_kv_heads
+    from tpusystem.parallel.mesh import DATA, FSDP, MODEL
+
+    shape = dict(mesh.shape)
+    batch_axes = tuple(axis for axis in (DATA, FSDP) if shape.get(axis, 1) > 1)
+    if batch_axes and query.shape[0] % prod(shape[a] for a in batch_axes):
+        batch_axes = ()
+    model = shape.get(MODEL, 1)
+    head_axis = MODEL if model > 1 and query.shape[2] % model == 0 else None
+    if head_axis and key.shape[2] % model:
+        key, value = repeat_kv_heads(query, key, value)
+
+    spec = P(batch_axes or None, None, head_axis, None)
+
+    # check_vma=False: pallas_call out_shapes carry no varying-mesh-axis
+    # info, so shard_map's replication checker cannot see through the kernel
+    @functools.partial(jax.shard_map, mesh=mesh, check_vma=False,
+                       in_specs=(spec, spec, spec), out_specs=spec)
+    def mapped(q, k, v):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    return mapped(query, key, value)
