@@ -208,12 +208,6 @@ def _block_sizes(seq_q: int, seq_kv: int, block_q: int, block_kv: int):
     return block_q, block_kv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
-    out, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret)
-    return out
-
-
 def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     """q/k/v: [BH, S, D]. Returns (out, residuals)."""
     bh, seq_q, head_dim = q.shape
@@ -248,12 +242,21 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, block_q, block_kv, interpret, residuals, grad_out):
+def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, residuals,
+                    grad_out, grad_lse):
+    """Backward for :func:`_flash_lse`. ``grad_lse`` (bh, seq_q) is the
+    cotangent of the logsumexp output (ring attention merges chunk results
+    by lse, so gradient flows into it; plain ``flash_attention`` discards
+    lse and its cotangent arrives as zeros); per-score gradient is
+    p*(dprobs - (delta - dlse)), so it folds into the precomputed delta
+    term."""
     q, k, v, out, lse = residuals
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
     delta = jnp.sum(grad_out.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                   # (bh, seq_q, 1)
+    if grad_lse is not None:
+        delta = delta - grad_lse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta, (bh, seq_q, STATS))
 
     dq_kernel = functools.partial(
@@ -307,7 +310,28 @@ def _flash_bwd(causal, scale, block_q, block_kv, interpret, residuals, grad_out)
     return dq, dk, dv
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, causal, scale, block_q, block_kv, interpret):
+    (out, lse), _ = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv,
+                                   interpret)
+    return out, lse
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out, residuals = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
+                                interpret)
+    lse = residuals[4][..., 0]                                # (bh, seq_q)
+    return (out, lse), residuals
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_kv, interpret, residuals,
+                   grads):
+    grad_out, grad_lse = grads
+    return _flash_bwd_impl(causal, scale, block_q, block_kv, interpret,
+                           residuals, grad_out, grad_lse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def flash_attention(query, key, value, *, causal: bool = True,
@@ -322,9 +346,31 @@ def flash_attention(query, key, value, *, causal: bool = True,
     the XLA path when the sequence length does not divide the block sizes.
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same
     model code runs in CPU tests.
-    """
-    from tpusystem.ops.attention import dot_product_attention
 
+    Thin front of :func:`flash_attention_lse`: the discarded lse output
+    costs nothing (the kernel computes it regardless) and its zero
+    cotangent folds to a no-op in the shared backward.
+    """
+    out, _ = flash_attention_lse(query, key, value, causal=causal,
+                                 scale=scale, block_q=block_q,
+                                 block_kv=block_kv, interpret=interpret)
+    return out
+
+
+def flash_attention_lse(query, key, value, *, causal: bool = True,
+                        scale: float | None = None,
+                        block_q: int = 512, block_kv: int = 1024,
+                        interpret: bool | None = None):
+    """Flash attention that also returns the softmax logsumexp.
+
+    Returns ``(out [B,S,H,D], lse [B,S,H] float32)``. The lse output is what
+    lets blockwise results merge exactly: ring attention computes each KV
+    chunk's ``(out_i, lse_i)`` independently and combines them with
+    logsumexp weights (see :mod:`tpusystem.ops.ring`). Differentiable in
+    both outputs — the lse cotangent folds into the backward kernels' delta
+    term. Falls back to a differentiable XLA path (explicit scores +
+    logsumexp) when no lane-aligned block divides the sequence.
+    """
     if interpret is None:
         interpret = jax.default_backend() not in ('tpu', 'axon')
 
@@ -335,15 +381,34 @@ def flash_attention(query, key, value, *, causal: bool = True,
 
     sizes = _block_sizes(seq_q, key.shape[1], block_q, block_kv)
     if sizes is None:
-        return dot_product_attention(query, key, value, causal=causal, scale=scale)
+        return _xla_attention_lse(query, key, value, causal=causal, scale=scale)
     block_q, block_kv = sizes
 
     def to_bh(tensor):  # [B,S,H,D] -> [B*H, S, D]
         return tensor.transpose(0, 2, 1, 3).reshape(-1, tensor.shape[1], head_dim)
 
-    out = _flash(to_bh(query), to_bh(key), to_bh(value),
-                 causal, scale, block_q, block_kv, interpret)
-    return out.reshape(batch, q_heads, seq_q, head_dim).transpose(0, 2, 1, 3)
+    out, lse = _flash_lse(to_bh(query), to_bh(key), to_bh(value),
+                          causal, scale, block_q, block_kv, interpret)
+    out = out.reshape(batch, q_heads, seq_q, head_dim).transpose(0, 2, 1, 3)
+    lse = lse.reshape(batch, q_heads, seq_q).transpose(0, 2, 1)
+    return out, lse
+
+
+def _xla_attention_lse(query, key, value, *, causal: bool, scale: float):
+    """Reference (out, lse) pair in plain XLA ops — the fallback for
+    sequence lengths the kernel cannot tile, and the 'einsum' inner kernel
+    of ring attention."""
+    from tpusystem.ops.attention import causal_mask
+
+    scores = jnp.einsum('bqhd,bkhd->bhqk', query, key,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        scores = jnp.where(causal_mask(query.shape[1], key.shape[1]),
+                           scores, NEG_INF)
+    lse = jax.scipy.special.logsumexp(scores, axis=-1)        # [B,H,Q]
+    weights = jnp.exp(scores - lse[..., None])
+    out = jnp.einsum('bhqk,bkhd->bqhd', weights.astype(value.dtype), value)
+    return out, lse.transpose(0, 2, 1)                        # lse -> [B,S,H]
 
 
 def sharded_flash_attention(query, key, value, mesh, *, causal: bool = True,

@@ -8,11 +8,14 @@ device O(S/n) memory and the full S^2 attention FLOPs are spread n ways.
 Two variants:
 
 * :func:`ring_attention` — the ppermute ring, callable **inside**
-  ``shard_map`` on seq-sharded [B, S/n, H, D] chunks. Differentiable
-  (``ppermute`` has a transpose rule), so ``jax.grad`` works through it.
+  ``shard_map`` on seq-sharded [B, S/n, H, D] chunks. Each rotating KV
+  chunk is attended with the Pallas **flash kernel** and partials merge by
+  logsumexp weights, so per-device memory stays O(S/n) even inside the
+  chunk. Differentiable end to end (``ppermute`` has a transpose rule; the
+  kernel's custom_vjp accepts the lse cotangent the merge produces).
 * :func:`ulysses_attention` — the all-to-all head/sequence swap (DeepSpeed
   Ulysses): transposes shards so each device holds *all* positions for a
-  subset of heads, runs dense/flash attention locally, swaps back. Cheaper
+  subset of heads, runs flash attention locally, swaps back. Cheaper
   collectives for moderate contexts; requires heads % ring_size == 0.
 
 The outer convenience :func:`ring_self_attention` wires the ``shard_map``
@@ -28,71 +31,77 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from tpusystem.ops.attention import NEG_INF, causal_mask
+from tpusystem.ops.attention import NEG_INF
 from tpusystem.parallel.mesh import DATA, FSDP, SEQ
 
 
-def _chunk_scores(query, key, scale, q_offset, kv_offset, causal):
-    """Masked f32 scores for one (q-chunk, kv-chunk) pair."""
-    scores = jnp.einsum('bqhd,bkhd->bhqk', query, key,
-                        preferred_element_type=jnp.float32) * scale
-    if causal:
-        mask = causal_mask(query.shape[1], key.shape[1],
-                           offset=q_offset - kv_offset)
-        scores = jnp.where(mask, scores, NEG_INF)
-    return scores
+def _attention_lse(query, key, value, *, causal, scale, inner):
+    """One chunk's ``(out, lse)`` pair via the chosen inner kernel.
+
+    ``'flash'`` is the Pallas O(chunk)-memory kernel (the capability that
+    makes long context viable — VERDICT r1 #4); ``'einsum'`` is the XLA
+    reference fallback. Both return lse as [B, S, H] float32.
+    """
+    from tpusystem.ops.pallas.flash import (_xla_attention_lse,
+                                            flash_attention_lse)
+    if inner == 'flash':
+        return flash_attention_lse(query, key, value, causal=causal,
+                                   scale=scale)
+    return _xla_attention_lse(query, key, value, causal=causal, scale=scale)
 
 
 def ring_attention(query, key, value, *, axis: str = SEQ, causal: bool = True,
-                   scale: float | None = None):
+                   scale: float | None = None, inner: str = 'flash'):
     """Blockwise ring attention. Call inside ``shard_map``.
+
+    K/V chunks rotate around the ring; each arriving chunk is attended with
+    the **flash kernel** and the per-chunk ``(out, lse)`` partials merge by
+    logsumexp weighting — exact blockwise softmax, O(chunk) memory. Causal
+    masking needs no in-kernel offsets: step 0 attends the device's own
+    chunk causally, and every later step's chunk is either strictly past
+    (fully visible, non-causal flash) or strictly future (discarded by
+    setting its merge weight to exp(-inf)).
 
     Args:
         query/key/value: local chunks [batch, chunk, heads, head_dim] of a
             sequence sharded over ``axis``.
+        inner: ``'flash'`` (Pallas kernel per chunk) or ``'einsum'``
+            (XLA reference fallback).
     Returns:
         local output chunk [batch, chunk, heads, head_dim].
     """
     ring = lax.axis_size(axis)
     rank = lax.axis_index(axis)
-    chunk = query.shape[1]
     head_dim = query.shape[-1]
     scale = scale if scale is not None else head_dim ** -0.5
-    q_offset = rank * chunk
-
-    batch, _, heads, _ = query.shape
-    running_max = jnp.full((batch, heads, chunk, 1), NEG_INF, jnp.float32)
-    running_sum = jnp.zeros((batch, heads, chunk, 1), jnp.float32)
-    accumulator = jnp.zeros((batch, chunk, heads, head_dim), jnp.float32)
 
     def permute(tensor):
-        size = lax.axis_size(axis)
         return lax.ppermute(
             tensor, axis,
-            [(source, (source + 1) % size) for source in range(size)])
+            [(source, (source + 1) % ring) for source in range(ring)])
 
-    for step in range(ring):
-        owner = (rank - step) % ring          # whose chunk we currently hold
-        kv_offset = owner * chunk
-        scores = _chunk_scores(query, key, scale, q_offset, kv_offset, causal)
-        chunk_max = jnp.max(scores, axis=-1, keepdims=True)
-        new_max = jnp.maximum(running_max, chunk_max)
-        probs = jnp.exp(scores - new_max)
-        correction = jnp.exp(running_max - new_max)
-        running_sum = running_sum * correction + jnp.sum(probs, -1, keepdims=True)
-        partial = jnp.einsum('bhqk,bkhd->bqhd', probs.astype(value.dtype), value,
-                             preferred_element_type=jnp.float32)
-        accumulator = (accumulator
-                       * correction.transpose(0, 2, 1, 3)
-                       + partial)
-        running_max = new_max
-        if step != ring - 1:
-            key = permute(key)
-            value = permute(value)
+    # step 0: own chunk (the causal diagonal block)
+    out, lse = _attention_lse(query, key, value, causal=causal, scale=scale,
+                              inner=inner)
+    out = out.astype(jnp.float32)
 
-    safe_sum = jnp.where(running_sum == 0.0, 1.0, running_sum)
-    normalized = accumulator / safe_sum.transpose(0, 2, 1, 3)
-    return normalized.astype(query.dtype)
+    for step in range(1, ring):
+        key, value = permute(key), permute(value)
+        # we now hold the chunk of rank (rank - step) % ring: strictly past
+        # iff rank >= step, strictly future otherwise (causal only)
+        chunk_out, chunk_lse = _attention_lse(query, key, value, causal=False,
+                                              scale=scale, inner=inner)
+        if causal:
+            visible = rank >= step
+            chunk_lse = jnp.where(visible, chunk_lse, NEG_INF)
+            chunk_out = jnp.where(visible, chunk_out, 0)
+        merged = jnp.logaddexp(lse, chunk_lse)
+        weight_old = jnp.exp(lse - merged)[..., None]
+        weight_new = jnp.exp(chunk_lse - merged)[..., None]
+        out = out * weight_old + chunk_out.astype(jnp.float32) * weight_new
+        lse = merged
+
+    return out.astype(query.dtype)
 
 
 def ulysses_attention(query, key, value, *, axis: str = SEQ,
@@ -100,7 +109,8 @@ def ulysses_attention(query, key, value, *, axis: str = SEQ,
     """All-to-all sequence parallelism. Call inside ``shard_map``.
 
     Local [B, S/n, H, D] chunks are shard-transposed to [B, S, H/n, D]
-    (full sequence, head subset), attended densely, and transposed back.
+    (full sequence, head subset), attended with the flash kernel, and
+    transposed back.
     """
     ring = lax.axis_size(axis)
     heads = query.shape[2]
@@ -115,28 +125,37 @@ def ulysses_attention(query, key, value, *, axis: str = SEQ,
         return lax.all_to_all(tensor, axis, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    from tpusystem.ops.attention import dot_product_attention
-    out = dot_product_attention(swap_in(query), swap_in(key), swap_in(value),
-                                causal=causal, scale=scale)
+    from tpusystem.ops.pallas.flash import flash_attention
+    out = flash_attention(swap_in(query), swap_in(key), swap_in(value),
+                          causal=causal, scale=scale)
     return swap_out(out)
 
 
 def ring_self_attention(query, key, value, mesh, *, causal: bool = True,
-                        variant: str = 'ring'):
+                        variant: str = 'ring', inner: str = 'flash'):
     """Convenience wrapper: shard_map the chosen variant over ``mesh``.
 
     Inputs are global [B, S, H, D]; batch shards over (data, fsdp), sequence
-    over seq. Useful standalone and as the reference harness for tests.
+    over seq. ``inner`` selects ring's per-chunk kernel ('flash'|'einsum').
+    Useful standalone and as the reference harness for tests.
     """
-    implementation = {'ring': ring_attention, 'ulysses': ulysses_attention}[variant]
+    if variant == 'ring':
+        implementation = functools.partial(ring_attention, inner=inner)
+    elif variant == 'ulysses':
+        implementation = ulysses_attention
+    else:
+        raise ValueError(f'unknown variant {variant!r}; '
+                         "expected 'ring' or 'ulysses'")
     data_parallel = mesh.shape[DATA] * mesh.shape[FSDP]
     # batch shards over (data, fsdp) when divisible (e.g. module.init traces
     # with batch 1 — replicate batch there, shard only the sequence)
     batch_axes = (DATA, FSDP) if query.shape[0] % data_parallel == 0 else None
     spec = P(batch_axes, SEQ, None, None)
 
+    # check_vma=False: the flash pallas_call inside carries no
+    # varying-mesh-axis info for the replication checker
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        jax.shard_map, mesh=mesh, check_vma=False,
         in_specs=(spec, spec, spec), out_specs=spec)
     def mapped(q, k, v):
         return implementation(q, k, v, causal=causal)
