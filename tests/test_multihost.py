@@ -398,6 +398,26 @@ class TestRecovery:
         finally:
             shutdown(hub, transports)
 
+    def test_excluded_rank_collective_fails_fast(self):
+        """A restarted (excluded) rank's collective raises immediately via
+        the hub's 'rejected' frame instead of blocking until its timeout:
+        its op counter restarted at 0, so its op_key can never match the
+        survivors' (ADVICE r1 #2)."""
+        hub, transports = pod(3)
+        try:
+            transports[2]._sock.shutdown(socket.SHUT_RDWR)
+            transports[2]._sock.close()
+            assert wait_until(lambda: 2 in hub._excluded)
+            revived = TcpTransport(hub.address, 2, 3)
+            assert wait_until(lambda: 2 in hub._clients)
+            start = time.monotonic()
+            with pytest.raises(RuntimeError, match='excluded'):
+                revived.allreduce(True, op='and', timeout=30)
+            assert time.monotonic() - start < 5   # failed fast, not timeout
+            revived.close()
+        finally:
+            shutdown(hub, transports)
+
     def test_vote_then_die_still_counts_and_survivor_vote_not_dropped(self):
         """A contribution received before the crash stays in the result;
         quota completion is keyed by rank, so the dead rank's early vote
@@ -439,7 +459,10 @@ class TestRecovery:
     def test_late_contribution_from_excluded_rank_dropped_without_leak(self):
         """A slow-but-alive rank marked lost by the heartbeat monitor: its
         late contribution must not resurrect a completed op (pending-entry
-        leak) — and it still receives the survivors' result."""
+        leak) — and its call fails fast with 'rejected' rather than racing
+        the survivors' result fanout (it is outside the quota; its vote was
+        dropped, so handing it the result would let it believe it
+        participated)."""
         import threading
         hub = Hub(3, heartbeat_timeout=0.3)
         transports = [
@@ -463,10 +486,12 @@ class TestRecovery:
             for thread in threads:
                 thread.join(timeout=10)
             assert results == {0: 1, 1: 1}
-            # the excluded rank contributes late: dropped, no pending leak,
-            # but the stored result still answers its call
-            results[2] = transports[2].allreduce(2, op='sum', timeout=10)
-            assert results[2] == 1
+            # the excluded rank contributes late: dropped, no pending
+            # leak, and the call fails fast instead of blocking to timeout
+            start = time.monotonic()
+            with pytest.raises(RuntimeError, match='excluded'):
+                transports[2].allreduce(2, op='sum', timeout=30)
+            assert time.monotonic() - start < 5
             assert wait_until(lambda: not hub._pending)
         finally:
             shutdown(hub, transports)

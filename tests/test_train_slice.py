@@ -146,3 +146,57 @@ def test_gradient_accumulation_rejects_indivisible_batch():
     state = init_state(module, optimizer, jnp.zeros((1, 28, 28)))
     with pytest.raises(AssertionError):
         step(state, jnp.zeros((8, 28, 28)), jnp.zeros((8,), jnp.int32))
+
+
+def test_gradient_accumulation_token_weighted_under_padding():
+    """With a masked LM loss and uneven padding across microbatches,
+    accumulate=N weights microbatches by unmasked-token count, so the
+    result still equals the full-batch step (ADVICE r1 #1)."""
+    from tpusystem.models import gpt2_tiny
+    from tpusystem.train import NextTokenLoss
+
+    from tpusystem.train import SGD
+    module = gpt2_tiny(attention='xla', dtype='float32')
+    # SGD: parameter deltas are lr*grad, so the comparison stays at float
+    # precision (Adam's rsqrt amplifies reorder noise on tiny grads)
+    optimizer = SGD(lr=1e-1)
+    criterion = NextTokenLoss()
+    apply_fn = flax_apply(module)
+    rng = np.random.default_rng(7)
+    tokens = np.asarray(rng.integers(0, 256, (8, 16)), np.int32)
+    # microbatch 0 (rows 0-3) heavily padded, the rest untouched:
+    # per-microbatch token counts differ, so equal-weight averaging drifts
+    tokens[:3, 4:] = -1
+    tokens = jnp.asarray(tokens)
+
+    full = build_train_step(apply_fn, criterion, optimizer, jit=False)
+    accum = build_train_step(apply_fn, criterion, optimizer, accumulate=4,
+                             jit=False)
+    state_a = init_state(module, optimizer, tokens[:1], rng=0)
+    state_b = init_state(module, optimizer, tokens[:1], rng=0)
+    state_a, (_, loss_a) = full(state_a, tokens, tokens)
+    state_b, (_, loss_b) = accum(state_b, tokens, tokens)
+
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(state_a.params),
+                    jax.tree.leaves(state_b.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_gradient_accumulation_bf16_params_compile():
+    """Weighted accumulation keeps the scan carry well-typed when params are
+    low-precision (grads accumulate in f32, cast back to the param dtype)."""
+    from tpusystem.models import gpt2_tiny
+    from tpusystem.train import NextTokenLoss
+
+    module = gpt2_tiny(attention='xla')
+    optimizer = Adam(lr=1e-3)
+    step = build_train_step(flax_apply(module), NextTokenLoss(), optimizer,
+                            accumulate=2, jit=False)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    state = init_state(module, optimizer, tokens[:1],
+                       param_dtype=jnp.bfloat16)
+    state, (_, loss) = step(state, tokens, tokens)
+    assert jax.tree.leaves(state.params)[0].dtype == jnp.bfloat16
+    assert np.isfinite(float(loss))

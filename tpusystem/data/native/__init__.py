@@ -43,7 +43,14 @@ def _build() -> ctypes.CDLL | None:
     if not target.exists():
         try:
             target.parent.mkdir(parents=True, exist_ok=True)
-            scratch = tempfile.mktemp(dir=target.parent, suffix='.so')
+            # mkstemp: each concurrent builder gets its own fd-backed scratch
+            # path (mktemp could hand two builders the same name and publish
+            # a torn .so)
+            fd, scratch = tempfile.mkstemp(dir=target.parent, suffix='.so')
+            os.close(fd)
+            # mkstemp's 0600 would survive os.replace and lock other users
+            # of a shared cache dir out of the published .so
+            os.chmod(scratch, 0o644)
             subprocess.run(
                 ['g++', '-O3', '-shared', '-fPIC', '-std=c++17', '-pthread',
                  str(_SOURCE), '-o', scratch],

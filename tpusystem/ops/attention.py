@@ -95,6 +95,13 @@ def cached_attention(module, query, key, value, max_seq: int):
     positions.
     """
     batch, length, kv_heads, head_dim = key.shape
+    if length > max_seq:
+        # static shapes let this raise at trace time; per-step overflow
+        # (cumulative tokens, a traced cursor) is the caller's contract —
+        # tpusystem.train.generate enforces it up front
+        raise ValueError(
+            f'prompt length {length} exceeds the KV cache capacity '
+            f'max_seq={max_seq}; raise max_seq or truncate the prompt')
     # Prefill is the call that creates the cache variables: detect it
     # before declaring them, so the prompt can attend over just its own
     # fresh K/V (causal) instead of the max_seq-wide zero-padded cache —

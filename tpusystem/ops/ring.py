@@ -47,7 +47,11 @@ def _attention_lse(query, key, value, *, causal, scale, inner):
     if inner == 'flash':
         return flash_attention_lse(query, key, value, causal=causal,
                                    scale=scale)
-    return _xla_attention_lse(query, key, value, causal=causal, scale=scale)
+    if inner == 'einsum':
+        return _xla_attention_lse(query, key, value, causal=causal,
+                                  scale=scale)
+    raise ValueError(f"unknown inner kernel {inner!r}; "
+                     "expected 'flash' or 'einsum'")
 
 
 def ring_attention(query, key, value, *, axis: str = SEQ, causal: bool = True,

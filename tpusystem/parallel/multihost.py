@@ -139,6 +139,9 @@ def _recv_frame(sock: socket.socket) -> tuple | None:
     return None if blob is None else pickle.loads(blob)
 
 
+# client-local sentinel: a 'rejected' frame resolves the waiting collective
+_REJECTED = object()
+
 _REDUCERS: dict[str, Callable[[list], Any]] = {
     'and': all,
     'or': any,
@@ -247,14 +250,24 @@ class Hub:
                     if rank in self._excluded:
                         # a rank outside the quota (crashed-then-revived or
                         # restarted) must not resurrect completed op_keys or
-                        # skew live ranks' sequence numbers: drop. It still
-                        # receives results, so its own call returns.
-                        continue
-                    values = self._pending.setdefault(op_key, {})
-                    values[rank] = value
-                    done = self._live() <= values.keys()
-                    if done:
-                        del self._pending[op_key]
+                        # skew live ranks' sequence numbers: reject it
+                        # explicitly. (Its op counter restarted at 0, so its
+                        # op_key can never line up with the survivors' —
+                        # without the reject it would block until timeout.)
+                        excluded = True
+                    else:
+                        excluded = False
+                        values = self._pending.setdefault(op_key, {})
+                        values[rank] = value
+                        done = self._live() <= values.keys()
+                        if done:
+                            del self._pending[op_key]
+                if excluded:
+                    try:
+                        _send_frame(sock, ('rejected', op_key))
+                    except OSError:
+                        pass
+                    continue
                 if done:
                     self._emit_result(op_key, values)
 
@@ -281,7 +294,10 @@ class Hub:
         restarted worker's op counters restart at 0, so its contributions
         cannot line up with the survivors'; full re-admission is the
         restart-resume cycle, :mod:`tpusystem.parallel.recovery`). It still
-        receives events and collective results. Caller holds the lock."""
+        receives events and control frames, but NOT collective results: its
+        own collective calls fail fast with a 'rejected' frame (silently
+        consuming survivor results while its own contributions are dropped
+        would let it believe it participated). Caller holds the lock."""
         return set(range(self.size)) - self._excluded
 
     def _emit_result(self, op_key: tuple, values: dict[int, Any]) -> None:
@@ -291,7 +307,10 @@ class Hub:
         kind_name, op, _ = op_key
         result = (_REDUCERS[op](contributions) if kind_name == 'reduce'
                   else contributions)
-        self._fanout(('result', op_key, result))
+        # live_only: an excluded-but-connected rank (heartbeat stall whose op
+        # counter still lines up) must not race a 'result' against its
+        # 'rejected' — its collectives deterministically fail fast
+        self._fanout(('result', op_key, result), live_only=True)
 
     def _complete_satisfied(self) -> None:
         """After a loss, pending collectives that were only waiting on the
@@ -306,10 +325,12 @@ class Hub:
         for op_key, values in ready:
             self._emit_result(op_key, values)
 
-    def _fanout(self, frame: tuple, exclude: int | None = None) -> None:
+    def _fanout(self, frame: tuple, exclude: int | None = None,
+                live_only: bool = False) -> None:
         with self._locks:
             targets = [sock for rank, sock in self._clients.items()
-                       if rank != exclude]
+                       if rank != exclude
+                       and not (live_only and rank in self._excluded)]
         for sock in targets:
             try:
                 _send_frame(sock, frame)
@@ -430,6 +451,16 @@ class TcpTransport:
                 with self._results_lock:
                     box = self._results.setdefault(op_key, queue.Queue())
                 box.put(result)
+            elif kind == 'rejected':
+                # the hub excluded this rank from the quota; fail the
+                # waiting call fast instead of letting it hit its timeout.
+                # Deliver only to a registered box (always present for own
+                # ops — registered before send); a stray late frame must
+                # not leak a fresh queue into _results.
+                with self._results_lock:
+                    box = self._results.get(frame[1])
+                if box is not None:
+                    box.put(_REJECTED)
             elif kind in ('lost', 'joined'):
                 if self.on_control is not None:
                     self.on_control(frame)
@@ -451,6 +482,11 @@ class TcpTransport:
         result = box.get(timeout=timeout)
         with self._results_lock:
             self._results.pop(op_key, None)
+        if result is _REJECTED:
+            raise RuntimeError(
+                f'rank {self.rank} is excluded from collectives (it crashed, '
+                'timed out, or restarted); re-admission is the restart-resume '
+                'cycle — see tpusystem.parallel.recovery')
         return result
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:

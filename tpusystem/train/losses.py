@@ -47,11 +47,20 @@ class WithAuxLoss:
     """Wrap a criterion for models whose outputs are ``(predictions, aux)``
     — e.g. MoE models returning router load-balance losses
     (:mod:`tpusystem.ops.moe`). The aux term (already scaled by the model's
-    coefficients) adds to the base loss; ``coef`` rescales it globally."""
+    coefficients) adds to the base loss; ``coef`` rescales it globally.
+
+    Under gradient accumulation the aux term is approximate either way:
+    load balance is nonlinear in batch composition, so per-microbatch aux
+    values cannot reproduce the full-batch value exactly. The inner
+    criterion's ``weight`` (unmasked-token count) is forwarded because
+    routing pressure is per token — the base-loss term stays exact and the
+    aux term is token-weighted rather than microbatch-weighted."""
 
     def __init__(self, criterion, coef: float = 1.0):
         self.criterion = criterion
         self.coef = coef
+        if hasattr(criterion, 'weight'):  # forward the accumulation weight
+            self.weight = criterion.weight
 
     def __call__(self, outputs, targets):
         predictions, aux = outputs
@@ -119,6 +128,12 @@ class ChunkedNextTokenLoss:
             loss = loss + self.z_loss * jnp.sum(z_terms) / total
         return loss
 
+    def weight(self, tokens):
+        """Unmasked-token count — the accumulation weight that makes
+        microbatched means equal the full-batch mean under padding (see
+        ``build_train_step(accumulate=...)``)."""
+        return jnp.sum((tokens[:, 1:] >= 0).astype(jnp.float32))
+
 
 @register
 class NextTokenLoss:
@@ -140,3 +155,9 @@ class NextTokenLoss:
             logsumexp = jax.nn.logsumexp(shifted_logits.astype(jnp.float32), axis=-1)
             loss = loss + self.z_loss * jnp.sum((logsumexp ** 2) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
         return loss
+
+    def weight(self, tokens):
+        """Unmasked-token count — the accumulation weight that makes
+        microbatched means equal the full-batch mean under padding (see
+        ``build_train_step(accumulate=...)``)."""
+        return jnp.sum((tokens[:, 1:] >= 0).astype(jnp.float32))

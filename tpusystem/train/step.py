@@ -65,10 +65,14 @@ def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
     microbatches inside the step (``lax.scan``), averaging gradients
     before the single optimizer update — the activation-memory lever when
     the target global batch does not fit (grads add one params-sized
-    buffer; activations shrink by N). Per-example-mean losses make the
-    result equal to the full-batch step up to float reordering. With
-    accumulation, the returned ``outputs`` are the final microbatch's and
-    ``loss`` is the mean over microbatches.
+    buffer; activations shrink by N). When the criterion exposes
+    ``weight(targets)`` (the masked LM losses return their unmasked-token
+    count), microbatch losses and grads are weighted by it, so the result
+    equals the full-batch step even when padding gives microbatches
+    different token counts; criteria without ``weight`` are averaged
+    equally (exact for per-example-mean losses). With accumulation, the
+    returned ``outputs`` are the final microbatch's and ``loss`` is the
+    weighted mean over microbatches.
 
     For activation rematerialisation use per-layer checkpointing at the
     model level (e.g. ``GPT2(remat=True)``) — whole-forward checkpointing
@@ -96,28 +100,41 @@ def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
                      jax.random.split(dropout_rng, accumulate))
             params = state.params
 
+            weight_fn = getattr(criterion, 'weight', None)
+
             def one(carry, xs):
-                grads_acc, loss_acc, _ = carry
+                grads_acc, loss_acc, weight_acc, _ = carry
                 micro_inputs, micro_targets, rng = xs
                 (loss, outputs), grads = jax.value_and_grad(
                     objective, has_aux=True)(params, micro_inputs,
                                              micro_targets, rng)
+                weight = (jnp.float32(weight_fn(micro_targets)) if weight_fn
+                          else jnp.float32(1.0))
                 # outputs ride the CARRY (last microbatch wins): stacking
                 # them as scan ys would materialize the full-batch outputs
                 # buffer this feature exists to avoid
-                return (jax.tree.map(jnp.add, grads_acc, grads),
-                        loss_acc + loss, outputs), None
+                return (jax.tree.map(
+                            lambda acc, g: acc + g.astype(jnp.float32) * weight,
+                            grads_acc, grads),
+                        loss_acc + loss * weight, weight_acc + weight,
+                        outputs), None
 
             first = jax.tree.map(lambda leaf: leaf[0], micro)
             output_shapes = jax.eval_shape(
                 lambda *xs: objective(params, *xs)[1], *first[:2], first[2])
             empty = jax.tree.map(
                 lambda s: jnp.zeros(s.shape, s.dtype), output_shapes)
-            zeros = jax.tree.map(jnp.zeros_like, params)
-            (grads, loss_sum, outputs), _ = jax.lax.scan(
-                one, (zeros, 0.0, empty), micro)
-            grads = jax.tree.map(lambda g: g / accumulate, grads)
-            loss = loss_sum / accumulate
+            # grads accumulate in float32 regardless of param dtype (exact
+            # token-count weights + stable sums; standard practice), cast
+            # back to the param dtype for the optimizer
+            zeros = jax.tree.map(
+                lambda leaf: jnp.zeros(leaf.shape, jnp.float32), params)
+            (grads, loss_sum, weight_sum, outputs), _ = jax.lax.scan(
+                one, (zeros, jnp.float32(0), jnp.float32(0), empty), micro)
+            weight_sum = jnp.maximum(weight_sum, 1e-8)  # all-pad batch guard
+            grads = jax.tree.map(
+                lambda g, p: (g / weight_sum).astype(p.dtype), grads, params)
+            loss = loss_sum / weight_sum
         updates, opt_state = transform.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
