@@ -76,6 +76,7 @@ def test_flash_falls_back_on_indivisible_lengths():
 
 
 @pytest.mark.parametrize('variant', ['ring', 'ulysses'])
+@pytest.mark.slow
 def test_sequence_parallel_matches_single_device(qkv, variant):
     q, k, v = qkv
     reference = dot_product_attention(q, k, v, causal=True)
@@ -86,6 +87,7 @@ def test_sequence_parallel_matches_single_device(qkv, variant):
 
 
 @pytest.mark.parametrize('variant', ['ring', 'ulysses'])
+@pytest.mark.slow
 def test_sequence_parallel_gradients(qkv, variant):
     q, k, v = qkv
     mesh = MeshSpec(data=2, seq=4).build()
@@ -107,6 +109,7 @@ def test_sequence_parallel_gradients(qkv, variant):
                                    atol=5e-5)
 
 
+@pytest.mark.slow
 def test_ring_noncausal():
     rng = np.random.default_rng(5)
     q = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
@@ -117,6 +120,7 @@ def test_ring_noncausal():
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt2_ring_attention_long_context_trains():
     """GPT-2 with seq-sharded ring attention: activations shard over the seq
     axis, attention runs on the ppermute ring, loss matches the dense model."""
@@ -229,6 +233,7 @@ def test_sharded_flash_indivisible_axes_replicate():
                                atol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt2_flash_trains_under_tensor_parallel_fsdp():
     """attention='flash' composes with the TensorParallel(fsdp=True) policy:
     one full sharded train step runs and the loss matches the xla kernel."""
@@ -284,6 +289,7 @@ def test_flash_lse_matches_reference_and_grads(qkv):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-5)
 
 
+@pytest.mark.slow
 def test_ring_einsum_inner_fallback_matches(qkv):
     """inner='einsum' (the XLA fallback path) stays at parity too."""
     q, k, v = qkv

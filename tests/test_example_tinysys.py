@@ -27,6 +27,7 @@ def tinysys_main(tmp_path, monkeypatch):
     return module
 
 
+@pytest.mark.slow
 def test_trains_tracks_and_resumes(tinysys_main, capsys):
     tinysys_main.main(epochs=2)
     out = capsys.readouterr().out

@@ -29,6 +29,7 @@ def _pair(module_logits, module_features, tokens):
     return params, logits, features
 
 
+@pytest.mark.slow
 def test_gpt2_value_and_grad_parity(tokens):
     reference, fused = gpt2_tiny(), gpt2_tiny(return_features=True)
     params, logits, features = _pair(reference, fused, tokens)
@@ -110,6 +111,7 @@ def test_llama_head_param_path_unchanged():
     assert dim == (module.dim, module.vocab_size)
 
 
+@pytest.mark.slow
 def test_pipelined_gpt2_fused_loss_matches_logits_path():
     """return_features on the pipelined variant: same loss as the full
     logits path on the same stacked parameters (2-stage virtual mesh)."""

@@ -28,6 +28,7 @@ from tpusystem.train import ChunkedNextTokenLoss, NextTokenLoss
     mask_tail=st.integers(0, 3),
     seed=st.integers(0, 2**16),
 )
+@pytest.mark.slow
 def test_chunked_loss_matches_dense_loss(batch, seq, vocab, dim, chunks,
                                          tied, z_loss, mask_tail, seed):
     rng = np.random.default_rng(seed)

@@ -32,6 +32,7 @@ def prompt():
 
 
 @pytest.mark.parametrize('family', [gpt2_tiny, llama_tiny])
+@pytest.mark.slow
 def test_greedy_decode_matches_full_forward(family, prompt):
     module = family(dtype='float32')
     params = module.init(jax.random.PRNGKey(0), prompt)['params']
@@ -75,6 +76,7 @@ def test_capacity_overflow_raises(prompt):
         generate(module, params, prompt, steps=128)
 
 
+@pytest.mark.slow
 def test_moe_model_decodes_matching_full_forward(prompt):
     """MoE decode drops the training-only aux output; in a no-drop config
     (k == experts, capacity covers every token — chosen deliberately) it

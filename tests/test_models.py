@@ -1,5 +1,7 @@
 """Model zoo tests (tiny configs, CPU)."""
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -33,6 +35,7 @@ def test_gpt2_causality():
                            np.asarray(logits_b[0, 10:]))
 
 
+@pytest.mark.slow
 def test_gpt2_memorizes_one_batch():
     module = gpt2_tiny()
     optimizer = AdamW(lr=1e-3)
@@ -46,6 +49,7 @@ def test_gpt2_memorizes_one_batch():
     assert float(loss) < first * 0.2
 
 
+@pytest.mark.slow
 def test_gpt2_tensor_parallel_shards_and_trains():
     mesh = MeshSpec(data=2, fsdp=2, model=2).build()
     module = gpt2_tiny()
@@ -62,6 +66,7 @@ def test_gpt2_tensor_parallel_shards_and_trains():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_gpt2_gspmd_matches_single_device():
     """TP+FSDP sharded training reproduces single-device numerics."""
     def run(mesh, policy):
@@ -146,6 +151,7 @@ def test_llama_gqa_matches_repeated_kv():
     np.testing.assert_allclose(np.asarray(grouped), np.asarray(full), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_llama_memorizes_one_batch():
     from tpusystem.models import llama_tiny
     module = llama_tiny(dtype='float32')
@@ -160,6 +166,7 @@ def test_llama_memorizes_one_batch():
     assert float(loss) < first * 0.2
 
 
+@pytest.mark.slow
 def test_llama_tensor_parallel_shards_and_trains():
     from tpusystem.models import llama_tiny
     mesh = MeshSpec(data=2, fsdp=2, model=2).build()
@@ -185,6 +192,7 @@ def test_llama3_8b_preset_shape():
     assert module.remat  # 8B needs rematerialization
 
 
+@pytest.mark.slow
 def test_resnet_forward_shape():
     from tpusystem.models import resnet_tiny
     module = resnet_tiny()
@@ -206,6 +214,7 @@ def test_resnet50_parameter_count():
     assert 25e6 < count < 26.5e6, count
 
 
+@pytest.mark.slow
 def test_resnet_learns_one_batch():
     from tpusystem.models import resnet_tiny
     from tpusystem.train import CrossEntropyLoss
@@ -242,6 +251,7 @@ def test_resnet_data_parallel():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_graft_entry_dryrun():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)

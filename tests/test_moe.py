@@ -6,6 +6,8 @@ layer's dense-equivalence limit, and an expert-parallel GPT-2 train step on
 a simulated (data x expert) mesh.
 """
 
+import pytest
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -50,6 +52,7 @@ def test_first_choices_seat_before_second_choices():
     np.testing.assert_array_equal(expert0, [0.0, 1.0])
 
 
+@pytest.mark.slow
 def test_moe_single_expert_matches_dense_ffn():
     """experts=1, k=1, ample capacity reduces to a plain FFN."""
     layer = MoEMLP(experts=1, k=1, capacity_factor=4.0, dtype=jnp.float32)
@@ -70,6 +73,7 @@ def test_expert_capacity_bounds():
     assert expert_capacity(8, 2, 2, 100.0) == 8     # ceiling of all tokens
 
 
+@pytest.mark.slow
 def test_moe_gpt2_expert_parallel_train_step():
     mesh = MeshSpec(data=2, expert=4).build()
     model = GPT2(vocab_size=64, layers=2, dim=32, heads=4, max_seq=32,

@@ -117,6 +117,7 @@ def _train_losses(mesh, policy, batch, steps=4):
     return losses, state
 
 
+@pytest.mark.slow
 def test_dp_matches_single_device_numerics(digits_batch):
     single_losses, _ = _train_losses(single_device_mesh(), DataParallel(), digits_batch)
     mesh = MeshSpec(data=-1).build()

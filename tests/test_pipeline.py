@@ -84,6 +84,7 @@ def test_stage_sharding_placement():
     assert placed['wte']['embedding'].sharding.spec == ()
 
 
+@pytest.mark.slow
 def test_layers_not_divisible_by_stages_raises():
     model, mesh = make_model(stages=4, layers=6)
     tokens = jnp.zeros((2, 8), jnp.int32)

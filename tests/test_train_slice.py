@@ -148,6 +148,7 @@ def test_gradient_accumulation_rejects_indivisible_batch():
         step(state, jnp.zeros((8, 28, 28)), jnp.zeros((8,), jnp.int32))
 
 
+@pytest.mark.slow
 def test_gradient_accumulation_token_weighted_under_padding():
     """With a masked LM loss and uneven padding across microbatches,
     accumulate=N weights microbatches by unmasked-token count, so the
@@ -184,6 +185,7 @@ def test_gradient_accumulation_token_weighted_under_padding():
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_gradient_accumulation_bf16_params_compile():
     """Weighted accumulation keeps the scan carry well-typed when params are
     low-precision (grads accumulate in f32, cast back to the param dtype)."""
