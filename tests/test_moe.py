@@ -99,3 +99,69 @@ def test_moe_gpt2_expert_parallel_train_step():
     assert logits.shape == (8, 16, 64)
     assert all(np.isfinite(losses)), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_sparse_routing_matches_dense_routing():
+    """route_top_k_sparse seats exactly the tokens route_top_k seats, in the
+    same slots with the same combine weights (choice-major priority)."""
+    from tpusystem.ops.moe import route_top_k_sparse
+    gates = jax.nn.softmax(
+        jax.random.normal(jax.random.PRNGKey(3), (32, 4)) * 2)
+    for k, capacity in [(1, 4), (2, 6), (2, 32)]:
+        dispatch, combine, fraction = route_top_k(gates, k=k, capacity=capacity)
+        token_ids, slots, weights, sparse_fraction = route_top_k_sparse(
+            gates, k=k, capacity=capacity)
+        experts = gates.shape[1]
+        dense_from_sparse = np.zeros((32, experts, capacity), np.float32)
+        combine_from_sparse = np.zeros_like(dense_from_sparse)
+        for token, slot, weight in zip(np.asarray(token_ids),
+                                       np.asarray(slots),
+                                       np.asarray(weights)):
+            if slot < experts * capacity:     # seated
+                expert, position = divmod(int(slot), capacity)
+                dense_from_sparse[token, expert, position] = 1.0
+                combine_from_sparse[token, expert, position] = weight
+        np.testing.assert_array_equal(dense_from_sparse, np.asarray(dispatch))
+        np.testing.assert_allclose(combine_from_sparse, np.asarray(combine),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(sparse_fraction),
+                                   np.asarray(fraction), atol=1e-6)
+
+
+@pytest.mark.slow
+def test_sparse_dispatch_layer_matches_dense_dispatch_layer():
+    """The full MoE layer produces the same output and aux loss through the
+    sort/scatter path as through the one-hot einsum path, including drops
+    (tight capacity) — forward and gradients."""
+    rng = jax.random.PRNGKey(5)
+    hidden = jax.random.normal(rng, (4, 16, 32), jnp.float32)
+
+    def build(dispatch):
+        module = MoEMLP(experts=4, k=2, capacity_factor=0.75,
+                        dtype=jnp.float32, dispatch=dispatch)
+        params = module.init(jax.random.PRNGKey(0), hidden)['params']
+        return module, params
+
+    dense_module, params = build('dense')
+    sparse_module, sparse_params = build('sparse')
+    chex_equal = jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        params, sparse_params)
+    del chex_equal
+
+    dense_out, dense_aux = dense_module.apply({'params': params}, hidden)
+    sparse_out, sparse_aux = sparse_module.apply({'params': params}, hidden)
+    np.testing.assert_allclose(np.asarray(dense_out), np.asarray(sparse_out),
+                               atol=2e-5)
+    np.testing.assert_allclose(float(dense_aux), float(sparse_aux), rtol=1e-6)
+
+    def loss(module):
+        def fn(p):
+            out, aux = module.apply({'params': p}, hidden)
+            return jnp.mean(out ** 2) + aux
+        return fn
+
+    dense_grads = jax.grad(loss(dense_module))(params)
+    sparse_grads = jax.grad(loss(sparse_module))(params)
+    for a, b in zip(jax.tree.leaves(dense_grads), jax.tree.leaves(sparse_grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)

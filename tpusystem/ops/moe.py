@@ -3,10 +3,19 @@
 The reference has only a dense MLP (SURVEY.md §2.4: "EP/MoE | absent");
 this module supplies the TPU-native design: experts live as one stacked
 weight tensor with a leading ``experts`` dimension sharded over the
-``expert`` mesh axis, and token routing is expressed as dense one-hot
-dispatch/combine einsums (the Switch-Transformer/GSPMD formulation). With
-the dispatched activations sharding-constrained to the expert axis, XLA
-inserts the all-to-alls over ICI itself — no hand-written collective.
+``expert`` mesh axis. Token routing has two formulations behind one layer:
+
+* **sparse** (single-shard default): sort/segment dispatch — a stable
+  argsort by expert id gives each assignment its position-in-expert, and
+  scatter/gather moves only the O(tokens·k) selected rows. This is the
+  scalable path: the dense tensors are O(tokens·experts·capacity) ≈
+  O(tokens²·k) in both memory and FLOPs.
+* **dense** (expert-sharded meshes): one-hot dispatch/combine einsums (the
+  Switch-Transformer/GSPMD formulation). With the dispatched activations
+  sharding-constrained to the expert axis, XLA inserts the all-to-alls
+  over ICI itself — no hand-written collective. Neither the global argsort
+  nor the slot scatter partitions along the token axis, so
+  ``dispatch='auto'`` keeps the dense form on any multi-device mesh.
 
 Capacity model: each expert processes at most
 ``capacity = round(k * tokens / experts * capacity_factor)`` tokens per
@@ -72,6 +81,44 @@ def route_top_k(gates: jax.Array, k: int, capacity: int):
     return dispatch, combine, fraction
 
 
+def route_top_k_sparse(gates: jax.Array, k: int, capacity: int):
+    """Sort-based routing: the O(tokens·k) replacement for the dense
+    [tokens, experts, capacity] one-hot tensors (SURVEY §2.4 mandates
+    ragged-style dispatch; the dense einsums are an O(tokens²)·k FLOP and
+    memory cliff at real expert counts).
+
+    Returns ``(token_ids, slots, weights, fraction)`` flat per-assignment
+    arrays (length ``tokens*k``): assignment ``i`` sends token
+    ``token_ids[i]`` to buffer row ``slots[i]`` (``experts*capacity`` means
+    dropped — scatter/gather with ``mode='drop'``/``fill`` discards it) and
+    its output is combined back with ``weights[i]``.
+
+    Seating matches :func:`route_top_k` exactly: assignments are flattened
+    choice-major and position-in-expert comes from a *stable* sort by
+    expert id, so every first choice seats before any second choice and
+    within a choice tokens seat in order.
+    """
+    tokens, experts = gates.shape
+    top_gates, top_experts = jax.lax.top_k(gates, k)
+    top_gates = top_gates / (jnp.sum(top_gates, -1, keepdims=True) + 1e-9)
+
+    expert_ids = top_experts.T.reshape(-1)             # [k*N] choice-major
+    weights = top_gates.T.reshape(-1)
+    token_ids = jnp.tile(jnp.arange(tokens), k)
+
+    order = jnp.argsort(expert_ids, stable=True)
+    ranks = jnp.argsort(order, stable=True)            # assignment -> sort pos
+    counts = jnp.bincount(expert_ids, length=experts)
+    starts = jnp.cumsum(counts) - counts
+    position = ranks - starts[expert_ids]              # position within expert
+    keep = position < capacity
+    slots = jnp.where(keep, expert_ids * capacity + position,
+                      experts * capacity)              # out of range = dropped
+
+    fraction = jnp.mean(jax.nn.one_hot(top_experts[:, 0], experts), axis=0)
+    return token_ids, slots, weights, fraction
+
+
 class MoEMLP(nn.Module):
     """Expert-parallel FFN: drop-in for the dense fc->gelu->proj block.
 
@@ -89,6 +136,7 @@ class MoEMLP(nn.Module):
     balance_coef: float = 1e-2
     z_coef: float = 1e-3
     mesh: object = None
+    dispatch: str = 'auto'   # 'sparse' | 'dense' | 'auto'
 
     @nn.compact
     def __call__(self, hidden):
@@ -109,23 +157,53 @@ class MoEMLP(nn.Module):
         gates = jax.nn.softmax(logits)
         capacity = expert_capacity(tokens, self.experts, self.k,
                                    self.capacity_factor)
-        dispatch, combine, fraction = route_top_k(gates, self.k, capacity)
+
+        # 'sparse' is the O(tokens·k) sort/scatter path — the single-shard
+        # default. Neither the global argsort nor the slot scatter is
+        # partitionable along the token axis, so under ANY multi-device
+        # mesh (expert-, data- or tensor-sharded) 'auto' keeps the dense
+        # one-hot einsums, which GSPMD partitions freely (and whose EP
+        # all-to-all it inserts itself).
+        mode = self.dispatch
+        if mode == 'auto':
+            multi_device = self.mesh is not None and self.mesh.size > 1
+            mode = 'dense' if multi_device else 'sparse'
+        if mode not in ('sparse', 'dense'):
+            raise ValueError(f'unknown dispatch {self.dispatch!r}; '
+                             "expected 'sparse', 'dense' or 'auto'")
+        compute = jnp.dtype(self.dtype)
+
+        if mode == 'sparse':
+            token_ids, slots, weights, fraction = route_top_k_sparse(
+                gates, self.k, capacity)
+            rows = flat.astype(compute)[token_ids]     # [k*N, D] gather
+            expert_in = jnp.zeros((self.experts * capacity, dim), compute)
+            expert_in = expert_in.at[slots].set(rows, mode='drop')
+            expert_in = expert_in.reshape(self.experts, capacity, dim)
+        else:
+            dispatch, combine, fraction = route_top_k(gates, self.k, capacity)
+            expert_in = jnp.einsum('nec,nd->ecd', dispatch.astype(compute),
+                                   flat.astype(compute))
 
         # Switch load-balance loss: experts * <fraction_dispatched * mean_prob>
         balance = self.experts * jnp.sum(fraction * jnp.mean(gates, axis=0))
         z_term = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
         aux = self.balance_coef * balance + self.z_coef * z_term
 
-        compute = jnp.dtype(self.dtype)
-        expert_in = jnp.einsum('nec,nd->ecd', dispatch.astype(compute),
-                               flat.astype(compute))
         expert_in = self._constrain(expert_in)
         grown = jnp.einsum('ecd,edh->ech', expert_in, w1.astype(compute))
         grown = nn.gelu(grown + b1[:, None].astype(compute))
         shrunk = jnp.einsum('ech,ehd->ecd', grown, w2.astype(compute))
         shrunk = shrunk + b2[:, None].astype(compute)
         shrunk = self._constrain(shrunk)
-        output = jnp.einsum('nec,ecd->nd', combine.astype(compute), shrunk)
+
+        if mode == 'sparse':
+            buffer = shrunk.reshape(self.experts * capacity, dim)
+            gathered = buffer.at[slots].get(mode='fill', fill_value=0)
+            output = jnp.zeros((tokens, dim), compute).at[token_ids].add(
+                gathered * weights[:, None].astype(compute))
+        else:
+            output = jnp.einsum('nec,ecd->nd', combine.astype(compute), shrunk)
         return output.reshape(*batch_shape, dim).astype(hidden.dtype), aux
 
     def _constrain(self, value):
