@@ -1,0 +1,83 @@
+"""Measure 8B-dimension components on 1x v5e: (a) one LlamaBlock fwd+bwd,
+(b) the 128k-vocab chunked LM head. Iterations are chained through the
+inputs so XLA cannot hoist the gradient out of the timing loop."""
+import sys, time, json
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
+import jax, jax.numpy as jnp, numpy as np
+
+PEAK = 197e12
+DIM, FFN, HEADS, KV, VOCAB = 4096, 14336, 32, 8, 128256
+
+def timed(run, *args, steps=8):
+    out = run(*args)
+    float(jax.tree.leaves(out)[0].sum())
+    start = time.perf_counter()
+    out = run(*args)
+    float(jax.tree.leaves(out)[0].sum())
+    return (time.perf_counter() - start) / steps
+
+def block_mfu(batch, seq, steps=8):
+    from tpusystem.models.llama import LlamaBlock
+    block = LlamaBlock(heads=HEADS, kv_heads=KV, ffn_dim=FFN,
+                       dtype=jnp.bfloat16, attention='flash', max_seq=seq)
+    hidden = jax.random.normal(jax.random.PRNGKey(0), (batch, seq, DIM), jnp.bfloat16)
+    params = block.init(jax.random.PRNGKey(1), hidden)['params']
+    pcount = sum(l.size for l in jax.tree.leaves(params))
+
+    def loss(p, h):
+        return jnp.mean(block.apply({'params': p}, h, True).astype(jnp.float32) ** 2)
+
+    grad = jax.value_and_grad(loss, argnums=(0, 1))
+    @jax.jit
+    def run(p, h):
+        def body(carry, _):
+            h, acc = carry
+            l, (gp, gh) = grad(p, h)
+            # chain h through its gradient so iterations stay sequential,
+            # and fold EVERY weight gradient into the output so XLA cannot
+            # dead-code-eliminate the wgrad matmuls (a silent 1.5x cheat)
+            acc = acc + sum(g.astype(jnp.float32).mean()
+                            for g in jax.tree.leaves(gp))
+            return ((h + gh.astype(h.dtype)), acc + l), None
+        (h, acc), _ = jax.lax.scan(body, (h, jnp.float32(0)), None, length=steps)
+        return acc + h.astype(jnp.float32).mean()
+
+    dt = timed(run, params, hidden, steps=steps)
+    flops = 6 * pcount * batch * seq + 12 * HEADS * seq * seq * (DIM // HEADS) * batch
+    mfu = flops / dt / PEAK
+    print(json.dumps({"component": "block", "batch": batch, "seq": seq,
+                      "ms": round(dt*1e3, 2), "mfu": round(mfu, 4)}))
+    return mfu, flops / (batch * seq)
+
+def head_mfu(batch, seq, chunks=16, steps=4):
+    from tpusystem.train import ChunkedNextTokenLoss
+    crit = ChunkedNextTokenLoss(chunks=chunks, tied=False)
+    feats = jax.random.normal(jax.random.PRNGKey(0), (batch, seq, DIM), jnp.bfloat16)
+    table = jax.random.normal(jax.random.PRNGKey(1), (DIM, VOCAB), jnp.bfloat16) * 0.02
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (batch, seq), 0, VOCAB)
+
+    grad = jax.value_and_grad(lambda f, t: crit((f, t), tokens), argnums=(0, 1))
+    @jax.jit
+    def run(f, t):
+        def body(carry, _):
+            f, acc = carry
+            l, (gf, gt) = grad(f, t)
+            # keep the table wgrad alive (see block_mfu)
+            acc = acc + gt.astype(jnp.float32).mean()
+            return ((f + gf.astype(f.dtype)), acc + l), None
+        (f, acc), _ = jax.lax.scan(body, (f, jnp.float32(0)), None, length=steps)
+        return acc + f.astype(jnp.float32).mean()
+    dt = timed(run, feats, table, steps=steps)
+    flops = 6 * DIM * VOCAB * batch * seq
+    mfu = flops / dt / PEAK
+    print(json.dumps({"component": "head+chunked_loss", "batch": batch, "seq": seq,
+                      "ms": round(dt*1e3, 2), "mfu": round(mfu, 4)}))
+    return mfu, flops / (batch * seq)
+
+bm, bft = block_mfu(batch=1, seq=8192)
+bm2, _ = block_mfu(batch=2, seq=4096)
+hm, hft = head_mfu(batch=1, seq=8192)
+total_ft = 32 * bft + hft
+proj = total_ft / (32 * bft / bm + hft / hm)
+print(json.dumps({"projected_8b_mfu_v5e_components": round(proj, 4),
+                  "block_share": round(32*bft/total_ft, 3)}))

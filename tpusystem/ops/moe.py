@@ -107,7 +107,8 @@ def route_top_k_sparse(gates: jax.Array, k: int, capacity: int):
     token_ids = jnp.tile(jnp.arange(tokens), k)
 
     order = jnp.argsort(expert_ids, stable=True)
-    ranks = jnp.argsort(order, stable=True)            # assignment -> sort pos
+    # invert the permutation with one scatter (a second argsort is O(n log n))
+    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(order.size))
     counts = jnp.bincount(expert_ids, length=experts)
     starts = jnp.cumsum(counts) - counts
     position = ranks - starts[expert_ids]              # position within expert
