@@ -1,0 +1,27 @@
+"""Compiled peak temp memory: GPipe-autodiff vs the 1F1B schedule
+(virtual 4-stage CPU mesh, 16 microbatches) — BASELINE.md round-2 numbers.
+"""
+import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+import jax, jax.numpy as jnp, numpy as np
+from tpusystem.parallel import force_host_platform
+force_host_platform(4)
+from tpusystem.models import GPT2Pipelined
+from tpusystem.parallel import MeshSpec
+from tpusystem.train import (NextTokenLoss, SGD, build_1f1b_train_step,
+                             build_train_step, flax_apply, init_state)
+
+M = 16
+mesh = MeshSpec(stage=4).build()
+model = GPT2Pipelined(vocab_size=256, layers=4, dim=256, heads=4,
+                      max_seq=512, dtype='float32', microbatches=M, mesh=mesh)
+tokens = jnp.zeros((M, 512), jnp.int32)
+state = init_state(model, SGD(lr=0.1), tokens[:1], rng=0)
+
+def report(tag, step_fn):
+    lowered = jax.jit(step_fn, donate_argnums=0).lower(state, tokens, tokens)
+    mem = lowered.compile().memory_analysis()
+    print(tag, 'temp MB:', round(mem.temp_size_in_bytes / 2**20, 1),
+          'total MB:', round((mem.temp_size_in_bytes + mem.argument_size_in_bytes) / 2**20, 1))
+
+report('gpipe+autodiff', build_train_step(flax_apply(model), NextTokenLoss(), SGD(lr=0.1), jit=False))
+report('1f1b          ', build_1f1b_train_step(model, NextTokenLoss(), SGD(lr=0.1), jit=False))

@@ -111,3 +111,94 @@ def test_pipeline_apply_plain_stack():
         reference = jnp.tanh(reference @ weights[index])
     np.testing.assert_allclose(np.asarray(out), np.asarray(reference),
                                rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_1f1b_matches_gpipe_autodiff_step():
+    """The 1F1B interleaved schedule produces the same loss and updated
+    parameters as autodiffing the GPipe pipeline_apply path — including
+    the tied embedding whose gradient merges head and tail contributions."""
+    from tpusystem.models import GPT2Pipelined
+    from tpusystem.train import (NextTokenLoss, SGD, build_1f1b_train_step,
+                                 build_train_step, flax_apply, init_state)
+    mesh = MeshSpec(data=2, stage=4).build()
+    model = GPT2Pipelined(vocab_size=256, layers=4, dim=64, heads=4,
+                          max_seq=64, dtype='float32', microbatches=8,
+                          mesh=mesh)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (16, 32)), jnp.int32)
+
+    def one_step(build):
+        state = init_state(model, SGD(lr=0.1), tokens[:1], rng=0)
+        step = build()
+        state, (_, loss) = step(state, tokens, tokens)
+        return float(loss), state.params
+
+    gpipe_loss, gpipe_params = one_step(lambda: build_train_step(
+        flax_apply(model), NextTokenLoss(), SGD(lr=0.1)))
+    f1b_loss, f1b_params = one_step(lambda: build_1f1b_train_step(
+        model, NextTokenLoss(), SGD(lr=0.1)))
+
+    np.testing.assert_allclose(gpipe_loss, f1b_loss, rtol=1e-5)
+    flat_a = jax.tree.leaves(gpipe_params)
+    flat_b = jax.tree.leaves(f1b_params)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
+
+
+@pytest.mark.slow
+def test_1f1b_single_stage_degenerates_to_microbatch_loop():
+    from tpusystem.models import GPT2Pipelined
+    from tpusystem.train import (NextTokenLoss, SGD, build_1f1b_train_step,
+                                 build_train_step, flax_apply, init_state)
+    mesh = MeshSpec(data=2).build(jax.devices()[:2])
+    model = GPT2Pipelined(vocab_size=128, layers=2, dim=32, heads=2,
+                          max_seq=32, dtype='float32', microbatches=2,
+                          mesh=mesh)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, 128, (4, 16)), jnp.int32)
+    state = init_state(model, SGD(lr=0.1), tokens[:1], rng=0)
+    step = build_1f1b_train_step(model, NextTokenLoss(), SGD(lr=0.1))
+    state, (_, loss) = step(state, tokens, tokens)
+    reference = build_train_step(flax_apply(model), NextTokenLoss(), SGD(lr=0.1))
+    ref_state = init_state(model, SGD(lr=0.1), tokens[:1], rng=0)
+    ref_state, (_, ref_loss) = reference(ref_state, tokens, tokens)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    # params too: loss alone cannot catch dropped embedding gradients
+    for a, b in zip(jax.tree.leaves(ref_state.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)
+
+
+@pytest.mark.slow
+def test_1f1b_token_weighted_under_padding():
+    """With a masked LM loss and pad-heavy microbatches, the 1F1B step
+    weights microbatches by unmasked-token count like
+    build_train_step(accumulate=...) — the full-batch reference and the
+    pipelined step still agree."""
+    from tpusystem.models import GPT2Pipelined
+    from tpusystem.train import (NextTokenLoss, SGD, build_1f1b_train_step,
+                                 build_train_step, flax_apply, init_state)
+    mesh = MeshSpec(stage=4).build(jax.devices()[:4])
+    model = GPT2Pipelined(vocab_size=128, layers=4, dim=32, heads=2,
+                          max_seq=32, dtype='float32', microbatches=4,
+                          mesh=mesh)
+    tokens = np.random.default_rng(2).integers(0, 128, (8, 16)).astype(np.int32)
+    tokens[:3, 4:] = -1                  # uneven padding across microbatches
+    tokens = jnp.asarray(tokens)
+
+    state = init_state(model, SGD(lr=0.1), jnp.abs(tokens[:1]), rng=0)
+    step = build_1f1b_train_step(model, NextTokenLoss(), SGD(lr=0.1))
+    state, (_, loss) = step(state, jnp.abs(tokens), tokens)
+
+    reference = build_train_step(flax_apply(model), NextTokenLoss(), SGD(lr=0.1))
+    ref_state = init_state(model, SGD(lr=0.1), jnp.abs(tokens[:1]), rng=0)
+    ref_state, (_, ref_loss) = reference(ref_state, jnp.abs(tokens), tokens)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(ref_state.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-6)

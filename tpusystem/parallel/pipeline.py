@@ -132,6 +132,221 @@ def _stage_scan(block_fn: BlockFn):
     return run
 
 
+def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
+                   weight_fn=None):
+    """1F1B-scheduled pipelined loss + gradients (one combined pass).
+
+    The GPipe path (:func:`pipeline_apply` under ``jax.grad``) stashes
+    O(microbatches) activations per stage because the backward replays the
+    whole forward scan in reverse. This schedule interleaves: in round
+    ``r`` stage ``s`` *forwards* microbatch ``r - s`` and *backwards*
+    microbatch ``r - (2*stages - 2 - s)``, so a microbatch's backward runs
+    at most ``2*(stages - 1 - s) + 1`` rounds after its forward and the
+    per-stage stash is bounded by ``2*stages - 1`` microbatch *inputs*
+    (block outputs are rematerialized in the backward ``jax.vjp``),
+    independent of the microbatch count — the activation-memory lever for
+    deep pipes. The last stage backwards each microbatch in the same round
+    it forwards it (classic 1F1B).
+
+    Because every stage executes masked forward+backward units every
+    round, total compute is ``(microbatches + 2*stages - 2)`` round-units
+    against GPipe's ``microbatches + stages - 1`` — memory is bought with
+    bubble FLOPs, so prefer this when activations, not time, are the
+    binding constraint.
+
+    No autodiff runs through the round loop: gradients are accumulated
+    explicitly, so ``jax.grad`` of the caller is neither needed nor
+    supported — the function *returns* the grads.
+
+    Args:
+        head_fn: ``(replicated_params, micro_inputs) -> activations`` —
+            the pre-pipe part (embeddings), executed at stage 0.
+        block_fn: ``(layer_params, x) -> x`` per layer; layers stacked and
+            stage-sharded as in :func:`pipeline_apply`.
+        tail_fn: ``(replicated_params, activations, micro_targets) ->
+            scalar mean loss`` — the post-pipe part (final norm, LM head,
+            criterion), executed at the last stage. ``replicated_params``
+            is ONE pytree shared by head and tail (a tied embedding
+            appears in both; its two gradient contributions are summed).
+        mesh: mesh with ``stage`` (and optionally data/fsdp) axes.
+        microbatches: microbatches per step; batch must divide by
+            ``data*fsdp*microbatches``.
+        weight_fn: optional ``(micro_targets) -> scalar`` microbatch weight
+            (the masked LM losses' unmasked-token count) — the same
+            weighting ``build_train_step(accumulate=...)`` applies, so
+            padded microbatches reproduce the full-batch mean. ``None``
+            weighs microbatches equally.
+
+    Returns:
+        ``step(replicated_params, stacked_params, inputs, targets) ->
+        (loss, (d_replicated, d_stacked))`` with the loss and gradients
+        weight-averaged over microbatches and data shards; gradients
+        accumulate in float32 and return in the parameter dtypes.
+    """
+    stages = mesh.shape[STAGE]
+    data_parallel = mesh.shape[DATA] * mesh.shape[FSDP]
+    batch_axes = (DATA, FSDP) if data_parallel > 1 else None
+    slots = 2 * stages - 1
+    rounds = microbatches + 2 * stages - 2
+    stage_body = _stage_scan(block_fn)
+
+    def masked(condition, tree):
+        return jax.tree.map(lambda leaf: jnp.where(condition, leaf, 0), tree)
+
+    def step(replicated_params, stacked_params, inputs, targets):
+        if inputs.shape[0] % (data_parallel * microbatches):
+            raise ValueError(
+                f'batch {inputs.shape[0]} not divisible by '
+                f'data*fsdp*microbatches = {data_parallel}*{microbatches}')
+
+        batch_spec = P(batch_axes)
+        param_specs = jax.tree.map(lambda _: P(STAGE), stacked_params)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=(P(), param_specs, batch_spec, batch_spec),
+            out_specs=(P(), (P(), param_specs)))
+        def run(reps, stacked, local_inputs, local_targets):
+            stage = lax.axis_index(STAGE)
+            count = stages
+            micro = lambda a: a.reshape(
+                (microbatches, a.shape[0] // microbatches) + a.shape[1:])
+            micro_in, micro_tgt = micro(local_inputs), micro(local_targets)
+
+            sample = head_fn(reps, micro_in[0])
+            zero_act = jnp.zeros_like(sample)
+            # gradient accumulators in float32 regardless of param dtype
+            # (stable sums + exact token-count weights), cast back at the end
+            zeros_f32 = lambda tree: jax.tree.map(
+                lambda leaf: jnp.zeros(leaf.shape, jnp.float32), tree)
+            carry = dict(
+                fwd_msg=zero_act,
+                bwd_msg=jnp.zeros_like(sample),
+                stash=jnp.zeros((slots,) + sample.shape, sample.dtype),
+                d_stacked=zeros_f32(stacked),
+                d_reps=zeros_f32(reps),
+                loss=jnp.float32(0),
+                weight=jnp.float32(0),
+            )
+
+            perm_fwd = [(i, (i + 1) % count) for i in range(count)]
+            perm_bwd = [(i, (i - 1) % count) for i in range(count)]
+
+            def round_body(carry, r):
+                m_f = r - stage
+                active_f = (m_f >= 0) & (m_f < microbatches)
+                m_f_safe = jnp.clip(m_f, 0, microbatches - 1)
+                feed = lax.dynamic_index_in_dim(micro_in, m_f_safe,
+                                                keepdims=False)
+                x = jnp.where(stage == 0, head_fn(reps, feed),
+                              carry['fwd_msg'])
+                stash = jnp.where(
+                    active_f,
+                    lax.dynamic_update_index_in_dim(
+                        carry['stash'], x, m_f_safe % slots, 0),
+                    carry['stash'])
+                y = stage_body(stacked, x)
+
+                # tail: the last stage turns its fresh forward into a loss
+                # and a cotangent seed in the same round (1F1B)
+                tgt = lax.dynamic_index_in_dim(micro_tgt, m_f_safe,
+                                               keepdims=False)
+                (loss_m, (d_tail_m, dy)) = jax.value_and_grad(
+                    tail_fn, argnums=(0, 1))(reps, y, tgt)
+                weight = (jnp.float32(weight_fn(tgt)) if weight_fn
+                          else jnp.float32(1.0))
+                # the weight rides the cotangent seed, so every downstream
+                # gradient (blocks, head) is weighted without extra work
+                dy = dy * weight.astype(dy.dtype)
+                is_last = stage == count - 1
+                active_t = active_f & is_last
+                loss_acc = carry['loss'] + jnp.where(active_t,
+                                                     loss_m * weight, 0)
+                weight_acc = carry['weight'] + jnp.where(active_t, weight, 0)
+
+                # backward unit: recompute this stage's forward from the
+                # stashed input (rematerialization) and pull grads through
+                m_b = r - (2 * count - 2 - stage)
+                active_b = (m_b >= 0) & (m_b < microbatches)
+                m_b_safe = jnp.clip(m_b, 0, microbatches - 1)
+                x_saved = lax.dynamic_index_in_dim(stash, m_b_safe % slots,
+                                                   keepdims=False)
+                cot = jnp.where(is_last, dy, carry['bwd_msg'])
+                _, vjp_fn = jax.vjp(stage_body, stacked, x_saved)
+                d_stacked_m, dx = vjp_fn(cot.astype(y.dtype))
+                accumulate = lambda acc_tree, grad_tree, condition: jax.tree.map(
+                    lambda acc, g: acc + jnp.where(condition,
+                                                   g.astype(jnp.float32), 0),
+                    acc_tree, grad_tree)
+                d_stacked = accumulate(carry['d_stacked'], d_stacked_m,
+                                       active_b)
+
+                # stage 0's input cotangent flows into the head (embeddings)
+                feed_b = lax.dynamic_index_in_dim(micro_in, m_b_safe,
+                                                  keepdims=False)
+                _, head_vjp = jax.vjp(lambda p: head_fn(p, feed_b), reps)
+                (d_head_m,) = head_vjp(dx)
+                d_reps = accumulate(
+                    accumulate(carry['d_reps'],
+                               jax.tree.map(lambda g: g * weight, d_tail_m),
+                               active_t),
+                    d_head_m, active_b & (stage == 0))
+
+                return dict(
+                    fwd_msg=lax.ppermute(y, STAGE, perm_fwd),
+                    bwd_msg=lax.ppermute(dx, STAGE, perm_bwd),
+                    stash=stash, d_stacked=d_stacked, d_reps=d_reps,
+                    loss=loss_acc, weight=weight_acc), None
+
+            if count > 1:
+                carry, _ = lax.scan(round_body, carry, jnp.arange(rounds))
+            else:
+                # degenerate single stage: plain microbatch loop (head must
+                # sit INSIDE the objective so embedding grads flow)
+                def single(carry, m):
+                    tgt = micro_tgt[m]
+                    weight = (jnp.float32(weight_fn(tgt)) if weight_fn
+                              else jnp.float32(1.0))
+
+                    def objective(reps, stacked):
+                        x = head_fn(reps, micro_in[m])
+                        return weight * tail_fn(reps, stage_body(stacked, x),
+                                                tgt)
+                    loss_m, (d_r, d_s) = jax.value_and_grad(
+                        objective, argnums=(0, 1))(reps, stacked)
+                    add_f32 = lambda acc_tree, grad_tree: jax.tree.map(
+                        lambda acc, g: acc + g.astype(jnp.float32),
+                        acc_tree, grad_tree)
+                    return dict(
+                        carry,
+                        loss=carry['loss'] + loss_m,
+                        weight=carry['weight'] + weight,
+                        d_reps=add_f32(carry['d_reps'], d_r),
+                        d_stacked=add_f32(carry['d_stacked'], d_s),
+                    ), None
+                carry, _ = lax.scan(single, carry, jnp.arange(microbatches))
+
+            # weighted means: sum(w_m * value_m) / sum(w_m) across the
+            # microbatches of every data shard (loss/replicated grads also
+            # sum over stage: each term lives on exactly one stage)
+            batch_reduce = batch_axes or ()
+            total = lax.psum(carry['weight'], (STAGE,) + batch_reduce)
+            loss = lax.psum(carry['loss'], (STAGE,) + batch_reduce) / total
+            d_reps = jax.tree.map(
+                lambda g, p: (lax.psum(g, (STAGE,) + batch_reduce)
+                              / total).astype(p.dtype),
+                carry['d_reps'], reps)
+            d_stacked = jax.tree.map(
+                lambda g, p: ((lax.psum(g, batch_reduce) if batch_reduce
+                               else g) / total).astype(p.dtype),
+                carry['d_stacked'], stacked)
+            return loss, (d_reps, d_stacked)
+
+        return run(replicated_params, stacked_params, inputs, targets)
+
+    return step
+
+
 def PipelineParallel(stacked_prefix: str = r'(^|/)h/', extra_rules=(),
                      fsdp: bool = False, fsdp_min_size: int = 4096) -> ShardingPolicy:
     """Sharding policy for pipelined models: leaves under ``stacked_prefix``

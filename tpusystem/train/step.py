@@ -154,6 +154,51 @@ def build_eval_step(apply_fn: ApplyFn, criterion: Criterion, *, jit: bool = True
     return jax.jit(step) if jit else step
 
 
+def build_1f1b_train_step(model, criterion: Criterion, optimizer,
+                          *, jit: bool = True):
+    """1F1B-scheduled train step for pipelined models (``GPT2Pipelined``).
+
+    Same ``step(state, inputs, targets) -> (state, (outputs, loss))``
+    contract as :func:`build_train_step` (``outputs`` is None — microbatch
+    outputs never exist whole under 1F1B), but the forward/backward runs
+    through :func:`tpusystem.parallel.pipeline.pipeline_train`: backwards
+    interleave with forwards so the per-stage activation stash is bounded
+    by the stage count instead of the microbatch count. Use when
+    activation memory, not step time, binds (see ``pipeline_train``'s
+    bubble-FLOPs tradeoff).
+
+    The model supplies the decomposition: ``_embed`` (head), ``_block_fn``
+    (stage body), ``_head`` (tail, composed with ``criterion``); its tied
+    embedding appears in both head and tail and both gradient
+    contributions are summed inside ``pipeline_train``.
+    """
+    from tpusystem.parallel.pipeline import pipeline_train
+
+    transform = optimizer.transform() if hasattr(optimizer, 'transform') else optimizer
+
+    def tail_fn(replicated, activations, micro_targets):
+        return criterion(model._head(replicated, activations), micro_targets)
+
+    train = pipeline_train(model._embed, model._block_fn(), tail_fn,
+                           model.mesh, microbatches=model.microbatches,
+                           weight_fn=getattr(criterion, 'weight', None))
+
+    def step(state: TrainState, inputs, targets):
+        replicated = {key: value for key, value in state.params.items()
+                      if key != 'h'}
+        loss, (d_replicated, d_stacked) = train(
+            replicated, state.params['h'], inputs, targets)
+        grads = dict(d_replicated, h=d_stacked)
+        updates, opt_state = transform.update(grads, state.opt_state,
+                                              state.params)
+        params = optax.apply_updates(state.params, updates)
+        state = state.replace(params=params, opt_state=opt_state,
+                              step=state.step + 1)
+        return state, (None, loss)
+
+    return jax.jit(step, donate_argnums=0) if jit else step
+
+
 def init_state(module, optimizer, sample_inputs, *, rng: int | jax.Array = 0,
                param_dtype=None) -> TrainState:
     """Initialize a :class:`TrainState` for a flax module.
