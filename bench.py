@@ -46,7 +46,7 @@ def main() -> None:
     # Perf recipe (each measured on a v5e chip):
     # - vocab padded 50257 -> 50304 (x128): the unpadded table mis-tiles the
     #   MXU on the head matmul (~10% whole-step MFU);
-    # - Pallas flash attention for the single-chip run;
+    # - Pallas flash attention for the single-chip run (1024/1024 tiles);
     # - fused chunked LM loss (return_features): the [B*S, vocab] f32 logits
     #   tensor is never materialized (~5% MFU, and unlocks batch >= 32);
     # - 60 steps per jit call (lax.fori_loop): per-dispatch overhead through

@@ -272,6 +272,7 @@ class GPT2Pipelined:
         self.microbatches, self.remat, self.mesh = microbatches, remat, mesh
         self.return_features = return_features
         self.block = Block(heads, mlp_ratio, 0.0, jnp.dtype(dtype))
+        self.stacked_key = 'h'   # params key of the stage-sharded layer stack
 
     def __call__(self, tokens, train: bool = False):
         raise TypeError('bind parameters via .apply(), like a flax module')

@@ -336,7 +336,7 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 def flash_attention(query, key, value, *, causal: bool = True,
                     scale: float | None = None,
-                    block_q: int = 512, block_kv: int = 1024,
+                    block_q: int = 1024, block_kv: int = 1024,
                     interpret: bool | None = None):
     """Flash attention over [batch, length, heads, head_dim] tensors.
 
@@ -359,7 +359,7 @@ def flash_attention(query, key, value, *, causal: bool = True,
 
 def flash_attention_lse(query, key, value, *, causal: bool = True,
                         scale: float | None = None,
-                        block_q: int = 512, block_kv: int = 1024,
+                        block_q: int = 1024, block_kv: int = 1024,
                         interpret: bool | None = None):
     """Flash attention that also returns the softmax logsumexp.
 

@@ -152,7 +152,9 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
     round, total compute is ``(microbatches + 2*stages - 2)`` round-units
     against GPipe's ``microbatches + stages - 1`` — memory is bought with
     bubble FLOPs, so prefer this when activations, not time, are the
-    binding constraint.
+    binding constraint. The head and tail (embedding / LM-head + loss) run
+    only on their own stage: inside ``shard_map``, ``lax.cond`` on a
+    device-varying predicate is real per-device control flow.
 
     No autodiff runs through the round loop: gradients are accumulated
     explicitly, so ``jax.grad`` of the caller is neither needed nor
@@ -189,9 +191,6 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
     slots = 2 * stages - 1
     rounds = microbatches + 2 * stages - 2
     stage_body = _stage_scan(block_fn)
-
-    def masked(condition, tree):
-        return jax.tree.map(lambda leaf: jnp.where(condition, leaf, 0), tree)
 
     def step(replicated_params, stacked_params, inputs, targets):
         if inputs.shape[0] % (data_parallel * microbatches):
@@ -238,8 +237,12 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 m_f_safe = jnp.clip(m_f, 0, microbatches - 1)
                 feed = lax.dynamic_index_in_dim(micro_in, m_f_safe,
                                                 keepdims=False)
-                x = jnp.where(stage == 0, head_fn(reps, feed),
-                              carry['fwd_msg'])
+                # inside shard_map, lax.cond on a device-varying predicate
+                # is real per-device control flow: only stage 0 pays for the
+                # embedding, only the last stage for the tail fwd+bwd below
+                x = lax.cond(stage == 0,
+                             lambda: head_fn(reps, feed),
+                             lambda: carry['fwd_msg'])
                 stash = jnp.where(
                     active_f,
                     lax.dynamic_update_index_in_dim(
@@ -251,15 +254,24 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 # and a cotangent seed in the same round (1F1B)
                 tgt = lax.dynamic_index_in_dim(micro_tgt, m_f_safe,
                                                keepdims=False)
-                (loss_m, (d_tail_m, dy)) = jax.value_and_grad(
-                    tail_fn, argnums=(0, 1))(reps, y, tgt)
+                is_last = stage == count - 1
+                active_t = active_f & is_last
+
+                def run_tail():
+                    loss_m, (d_tail_m, dy) = jax.value_and_grad(
+                        tail_fn, argnums=(0, 1))(reps, y, tgt)
+                    return loss_m, d_tail_m, dy
+
+                def skip_tail():
+                    return (jnp.float32(0), jax.tree.map(jnp.zeros_like, reps),
+                            jnp.zeros_like(y))
+
+                loss_m, d_tail_m, dy = lax.cond(active_t, run_tail, skip_tail)
                 weight = (jnp.float32(weight_fn(tgt)) if weight_fn
                           else jnp.float32(1.0))
                 # the weight rides the cotangent seed, so every downstream
                 # gradient (blocks, head) is weighted without extra work
                 dy = dy * weight.astype(dy.dtype)
-                is_last = stage == count - 1
-                active_t = active_f & is_last
                 loss_acc = carry['loss'] + jnp.where(active_t,
                                                      loss_m * weight, 0)
                 weight_acc = carry['weight'] + jnp.where(active_t, weight, 0)
@@ -284,13 +296,20 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 # stage 0's input cotangent flows into the head (embeddings)
                 feed_b = lax.dynamic_index_in_dim(micro_in, m_b_safe,
                                                   keepdims=False)
-                _, head_vjp = jax.vjp(lambda p: head_fn(p, feed_b), reps)
-                (d_head_m,) = head_vjp(dx)
+                active_h = active_b & (stage == 0)
+
+                def run_head_vjp():
+                    _, head_vjp = jax.vjp(lambda p: head_fn(p, feed_b), reps)
+                    (d_head_m,) = head_vjp(dx)
+                    return d_head_m
+
+                d_head_m = lax.cond(active_h, run_head_vjp,
+                                    lambda: jax.tree.map(jnp.zeros_like, reps))
                 d_reps = accumulate(
                     accumulate(carry['d_reps'],
                                jax.tree.map(lambda g: g * weight, d_tail_m),
                                active_t),
-                    d_head_m, active_b & (stage == 0))
+                    d_head_m, active_h)
 
                 return dict(
                     fwd_msg=lax.ppermute(y, STAGE, perm_fwd),

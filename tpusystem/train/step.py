@@ -183,12 +183,14 @@ def build_1f1b_train_step(model, criterion: Criterion, optimizer,
                            model.mesh, microbatches=model.microbatches,
                            weight_fn=getattr(criterion, 'weight', None))
 
+    stacked_key = getattr(model, 'stacked_key', 'h')
+
     def step(state: TrainState, inputs, targets):
         replicated = {key: value for key, value in state.params.items()
-                      if key != 'h'}
+                      if key != stacked_key}
         loss, (d_replicated, d_stacked) = train(
-            replicated, state.params['h'], inputs, targets)
-        grads = dict(d_replicated, h=d_stacked)
+            replicated, state.params[stacked_key], inputs, targets)
+        grads = dict(d_replicated, **{stacked_key: d_stacked})
         updates, opt_state = transform.update(grads, state.opt_state,
                                               state.params)
         params = optax.apply_updates(state.params, updates)
