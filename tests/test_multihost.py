@@ -560,3 +560,148 @@ class TestScale:
                 assert len(logs[rank]) == 16, (rank, len(logs[rank]))
         finally:
             shutdown(hub, transports)
+
+
+class TestDeputy:
+    """Hub redundancy: a standby deputy hub promotes when the primary dies
+    (ROADMAP robustness item — the star's single point of failure)."""
+
+    def _pod_with_deputy(self, size=3):
+        from tpusystem.parallel.multihost import connect, World
+        primary = Hub(size)
+        deputy = Hub(size, standby_of=primary.address)
+        transports = [
+            TcpTransport([primary.address, deputy.address], rank, size)
+            for rank in range(size)]
+        assert wait_until(lambda: len(primary._clients) == size)
+        return primary, deputy, transports
+
+    def test_deputy_promotes_and_serves_after_primary_death(self):
+        primary, deputy, transports = self._pod_with_deputy()
+        try:
+            assert deputy.is_standby
+            # baseline: collectives work on the primary
+            import threading
+            results = {}
+
+            def contribute(rank, value):
+                results[rank] = transports[rank].allreduce(value, op='sum',
+                                                           timeout=15)
+            threads = [threading.Thread(target=contribute, args=(r, r + 1))
+                       for r in range(3)]
+            for t in threads: t.start()
+            for t in threads: t.join(timeout=15)
+            assert results == {0: 6, 1: 6, 2: 6}
+
+            primary.close()                       # the star center dies
+            assert wait_until(lambda: not deputy.is_standby, timeout=10)
+            assert wait_until(lambda: len(deputy._clients) == 3, timeout=10)
+
+            # post-failover collectives complete on the promoted deputy
+            results.clear()
+            threads = [threading.Thread(target=contribute, args=(r, 10 * (r + 1)))
+                       for r in range(3)]
+            for t in threads: t.start()
+            for t in threads: t.join(timeout=15)
+            assert results == {0: 60, 1: 60, 2: 60}
+
+            # events flow through the deputy too
+            received = []
+            consumer = Consumer()
+
+            @consumer.handler
+            def on_synced(event: Synced):
+                received.append(event.epoch)
+
+            producer = DistributedProducer(transports[1])
+            producer.register(consumer)
+            sender = DistributedProducer(transports[0])
+            sender.wire(Synced)
+            sender.dispatch(Synced(epoch=7, loss=0.5))
+            assert wait_until(lambda: not producer._inbox.empty(), timeout=10)
+            producer.drain()
+            assert received == [7]
+        finally:
+            for transport in transports:
+                transport.close()
+            deputy.close()
+
+    def test_failover_mid_collective_raises_then_recovers(self):
+        from tpusystem.parallel.multihost import ControlPlaneFailover
+        primary, deputy, transports = self._pod_with_deputy()
+        try:
+            import threading
+            outcomes = {}
+
+            def contribute(rank):
+                try:
+                    outcomes[rank] = transports[rank].allreduce(
+                        rank, op='sum', timeout=30)
+                except ControlPlaneFailover:
+                    outcomes[rank] = 'failover'
+
+            # ranks 0 and 1 wait on rank 2, which never contributes
+            threads = [threading.Thread(target=contribute, args=(r,))
+                       for r in (0, 1)]
+            for t in threads: t.start()
+            assert wait_until(lambda: len(primary._pending) == 1)
+            primary.close()
+            for t in threads: t.join(timeout=30)
+            assert outcomes == {0: 'failover', 1: 'failover'}
+
+            # rank 2 burns its op-2 counter slot too so sequences realign
+            # (its op never reached the primary; on the deputy it would
+            # wait forever for ranks that already failed theirs)
+            import queue as queue_module
+            with pytest.raises((ControlPlaneFailover, queue_module.Empty)):
+                transports[2].allreduce(2, op='sum', timeout=3)
+
+            assert wait_until(lambda: not deputy.is_standby, timeout=10)
+            results = {}
+
+            def retry(rank):
+                results[rank] = transports[rank].allreduce(rank, op='sum',
+                                                           timeout=20)
+            threads = [threading.Thread(target=retry, args=(r,))
+                       for r in range(3)]
+            for t in threads: t.start()
+            for t in threads: t.join(timeout=25)
+            assert results == {0: 3, 1: 3, 2: 3}
+        finally:
+            for transport in transports:
+                transport.close()
+            deputy.close()
+
+    def test_standby_deputy_bounces_flaked_client_back(self):
+        """Split-brain guard: a client whose LINK to the live primary died
+        is redirected back by the standby deputy instead of being served —
+        the primary's exclusion policy then governs (it sees the crash)."""
+        primary, deputy, transports = self._pod_with_deputy()
+        try:
+            # flake rank 2's link only; the primary itself stays up
+            transports[2]._sock.shutdown(socket.SHUT_RDWR)
+            assert wait_until(lambda: 2 in primary._excluded)
+            assert deputy.is_standby
+            # rank 2 failed over to the deputy; its first op gets bounced
+            # ('standby'), it redials the primary (rejoins) and replays —
+            # where the exclusion policy rejects it: fail-fast, no split
+            with pytest.raises(RuntimeError, match='excluded|failover'):
+                transports[2].allreduce(True, op='and', timeout=15)
+            assert wait_until(lambda: 2 in primary._clients, timeout=10)
+            # survivors still complete on the primary (degraded quota)
+            import threading
+            results = {}
+
+            def contribute(rank):
+                results[rank] = transports[rank].allreduce(rank, op='sum',
+                                                           timeout=15)
+            threads = [threading.Thread(target=contribute, args=(r,))
+                       for r in (0, 1)]
+            for t in threads: t.start()
+            for t in threads: t.join(timeout=15)
+            assert results == {0: 1, 1: 1}
+        finally:
+            for transport in transports:
+                transport.close()
+            primary.close()
+            deputy.close()

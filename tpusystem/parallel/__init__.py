@@ -4,14 +4,16 @@ from tpusystem.parallel.mesh import (
     single_device_mesh,
 )
 from tpusystem.parallel.multihost import (
-    DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
-    World, WorkerJoined, WorkerLost, agree, connect, world,
+    ControlPlaneFailover, DistributedProducer, DistributedPublisher, Hub,
+    Loopback, TcpTransport, World, WorkerJoined, WorkerLost, agree, connect,
+    world,
 )
 from tpusystem.parallel.collectives import (
     all_gather, all_reduce_mean, all_reduce_sum, all_to_all, axis_index,
     axis_size, reduce_scatter, ring_shift,
 )
-from tpusystem.parallel.pipeline import PipelineParallel, pipeline_apply
+from tpusystem.parallel.pipeline import (PipelineParallel, pipeline_apply,
+                                         pipeline_train)
 from tpusystem.parallel.recovery import (LOST_WORKER_EXIT, WorkerLostError,
                                          recovery_consumer)
 from tpusystem.parallel.sharding import (
@@ -21,9 +23,10 @@ from tpusystem.parallel.sharding import (
 __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'force_host_platform',
            'ShardingPolicy', 'DataParallel', 'FullyShardedDataParallel',
-           'TensorParallel', 'PipelineParallel', 'pipeline_apply',
+           'TensorParallel', 'PipelineParallel', 'pipeline_apply', 'pipeline_train',
            'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE',
            'World', 'world', 'connect', 'agree', 'Hub', 'Loopback',
+           'ControlPlaneFailover',
            'TcpTransport', 'DistributedProducer', 'DistributedPublisher',
            'WorkerLost', 'WorkerJoined',
            'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT',

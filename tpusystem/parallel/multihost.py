@@ -141,6 +141,18 @@ def _recv_frame(sock: socket.socket) -> tuple | None:
 
 # client-local sentinel: a 'rejected' frame resolves the waiting collective
 _REJECTED = object()
+# client-local sentinel: the active hub died mid-collective (failover)
+_FAILED_OVER = object()
+
+
+class ControlPlaneFailover(RuntimeError):
+    """The active hub died while this collective was in flight.
+
+    The result may have reached some ranks and not others, so the op
+    cannot be transparently retried — surface the failover and let the
+    caller resynchronize at a safe point (epoch boundary / checkpoint
+    restore). Collectives issued *after* the failover complete normally
+    on the promoted deputy."""
 
 _REDUCERS: dict[str, Callable[[list], Any]] = {
     'and': all,
@@ -166,7 +178,8 @@ class Hub:
     """
 
     def __init__(self, size: int, host: str = '127.0.0.1', port: int = 0,
-                 heartbeat_timeout: float | None = None):
+                 heartbeat_timeout: float | None = None,
+                 standby_of: tuple | None = None):
         self.size = size
         self.heartbeat_timeout = heartbeat_timeout
         self._server = socket.create_server((host, port))
@@ -180,12 +193,64 @@ class Hub:
         # see _live() for why this set only grows
         self._excluded: set[int] = set()
         self._closed = threading.Event()
+        # Deputy mode: while the primary hub (at ``standby_of``) is alive,
+        # answer every contribution with ('standby',) so a client whose
+        # *link* to the primary flaked cannot split the pod — it is told to
+        # go back. When the hub-to-hub peer link dies, promote and serve.
+        self._standby = threading.Event()
+        self._peers: list[socket.socket] = []   # standby deputies' links
+        if standby_of is not None:
+            self._standby.set()
         self._threads = [threading.Thread(target=self._accept_loop, daemon=True)]
+        if standby_of is not None:
+            self._threads.append(threading.Thread(
+                target=self._peer_monitor, args=(standby_of,), daemon=True))
         if heartbeat_timeout:
             self._threads.append(
                 threading.Thread(target=self._monitor_loop, daemon=True))
         for thread in self._threads:
             thread.start()
+
+    @property
+    def is_standby(self) -> bool:
+        return self._standby.is_set()
+
+    def _peer_monitor(self, primary_address: tuple) -> None:
+        """Hold a hub-to-hub link to the primary; promote when it dies.
+
+        A broken link is confirmed by redial before promoting: a transient
+        blip on the peer socket alone must not create two active hubs
+        (split brain). Only when the primary is unreachable afresh does the
+        deputy take over."""
+
+        def dial(deadline: float):
+            while not self._closed.is_set() and time.monotonic() < deadline:
+                try:
+                    sock = socket.create_connection(primary_address,
+                                                    timeout=5.0)
+                    sock.settimeout(None)
+                    _send_frame(sock, ('peer',))
+                    return sock
+                except OSError:
+                    time.sleep(0.1)
+            return None
+
+        # bootstrap: generous window for the primary to come up at all
+        sock = dial(time.monotonic() + 60.0)
+        while sock is not None and not self._closed.is_set():
+            try:
+                while not self._closed.is_set():
+                    if _recv_frame(sock) is None:
+                        break
+            except OSError:
+                pass
+            finally:
+                sock.close()
+            # link died: confirm by redial (short window) before promoting
+            sock = dial(time.monotonic() + 3.0)
+        if not self._closed.is_set():
+            self._standby.clear()       # promote
+            self._complete_satisfied()
 
     def _accept_loop(self) -> None:
         while not self._closed.is_set():
@@ -196,6 +261,14 @@ class Hub:
             try:
                 frame = _recv_frame(sock)
             except OSError:
+                continue
+            if frame and frame[0] == 'peer':
+                # a standby deputy monitoring this hub: keep the socket open
+                # (its death is how the deputy learns we died); nothing to read
+                with self._locks:
+                    self._peers.append(sock)
+                threading.Thread(target=self._peer_hold, args=(sock,),
+                                 daemon=True).start()
                 continue
             if not frame or frame[0] != 'hello':
                 sock.close()
@@ -210,6 +283,16 @@ class Hub:
             threading.Thread(target=self._client_loop, args=(rank, sock),
                              daemon=True).start()
 
+    def _peer_hold(self, sock: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                if _recv_frame(sock) is None:
+                    return
+        except OSError:
+            pass
+        finally:
+            sock.close()
+
     def _client_loop(self, rank: int, sock: socket.socket) -> None:
         while not self._closed.is_set():
             try:
@@ -221,14 +304,21 @@ class Hub:
                 # the worker crashed — report it as lost immediately rather
                 # than waiting for the heartbeat monitor (which could never
                 # fire: the rank leaves the liveness table here).
+                # A STANDBY deputy never judges: clients touch it only
+                # transiently (failover probing before promotion, bounced
+                # flakes), and excluding them here would lock healthy ranks
+                # out of the quota for the deputy's whole post-promotion life.
+                standby = self._standby.is_set()
                 with self._locks:
                     self._clients.pop(rank, None)
                     last_seen = self._last_seen.pop(rank, time.monotonic())
-                    crashed = (frame is None and rank not in self._lost
+                    crashed = (not standby and frame is None
+                               and rank not in self._lost
                                and not self._closed.is_set())
                     if crashed:
                         self._lost.add(rank)
-                    self._excluded.add(rank)
+                    if not standby:
+                        self._excluded.add(rank)
                 sock.close()
                 if crashed:
                     self._fanout(('lost', rank, last_seen))
@@ -241,6 +331,14 @@ class Hub:
                 self._lost.discard(rank)     # any frame proves recovery
             kind = frame[0]
             if kind == 'hb':
+                continue
+            if self._standby.is_set() and kind in ('event', 'reduce', 'gather'):
+                # not the active hub: tell the client to go back to the
+                # primary (its link may have flaked while the primary lives)
+                try:
+                    _send_frame(sock, ('standby',))
+                except OSError:
+                    pass
                 continue
             if kind == 'event':
                 self._fanout(frame, exclude=rank)
@@ -341,9 +439,17 @@ class Hub:
         self._closed.set()
         self._server.close()
         with self._locks:
-            for sock in self._clients.values():
+            # shutdown before close: close() alone does not send FIN while
+            # another thread blocks in recv on the same fd, so clients (and
+            # standby deputies) would never learn this hub died
+            for sock in list(self._clients.values()) + self._peers:
+                try:
+                    sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 sock.close()
             self._clients.clear()
+            self._peers.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -394,33 +500,29 @@ class TcpTransport:
     rank (SPMD control flow — the same discipline XLA collectives require).
     """
 
-    def __init__(self, address: tuple, rank: int, size: int,
+    def __init__(self, address, rank: int, size: int,
                  heartbeat_interval: float | None = None,
                  connect_timeout: float = 60.0):
         self.rank = rank
         self.size = size
+        # one address, or an ordered [primary, deputy, ...] failover list
+        self._addresses = ([tuple(a) for a in address]
+                           if isinstance(address, list) else [tuple(address)])
+        self._active = 0
         self._channels: dict[str, Callable[[Any], None]] = {}
         self.on_control: Callable[[tuple], None] | None = None
-        # Hosts of a pod start concurrently; the hub may not be listening
-        # yet when a non-primary dials in — bounded retry with backoff.
-        deadline = time.monotonic() + connect_timeout
-        delay = 0.05
-        while True:
-            try:
-                self._sock = socket.create_connection(address, timeout=5.0)
-                break
-            except OSError:
-                if time.monotonic() >= deadline:
-                    raise
-                time.sleep(delay)
-                delay = min(delay * 2, 1.0)
-        self._sock.settimeout(None)
         self._send_lock = threading.Lock()
         self._results: dict[tuple, queue.Queue] = {}
         self._results_lock = threading.Lock()
+        # unanswered collective frames, replayed after a 'standby' redirect
+        # (the deputy deterministically dropped them); abandoned with
+        # _FAILED_OVER when the active hub died (delivery state unknown)
+        self._pending_sends: dict[tuple, tuple] = {}
         self._counter = itertools.count()
         self._closed = threading.Event()
-        self._send(('hello', rank))
+        self._reconnected = threading.Event()
+        self._sock = self._dial(self._addresses[0], connect_timeout)
+        self._reconnected.set()
         self._threads = [threading.Thread(target=self._recv_loop, daemon=True)]
         if heartbeat_interval:
             self._threads.append(threading.Thread(
@@ -429,17 +531,68 @@ class TcpTransport:
         for thread in self._threads:
             thread.start()
 
-    def _send(self, frame: tuple) -> None:
-        with self._send_lock:
-            _send_frame(self._sock, frame)
+    def _dial(self, address: tuple, connect_timeout: float) -> socket.socket:
+        # Hosts of a pod start concurrently; the hub may not be listening
+        # yet when a non-primary dials in — bounded retry with backoff.
+        deadline = time.monotonic() + connect_timeout
+        delay = 0.05
+        while True:
+            try:
+                sock = socket.create_connection(address, timeout=5.0)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(delay)
+                delay = min(delay * 2, 1.0)
+        sock.settimeout(None)
+        _send_frame(sock, ('hello', self.rank))
+        return sock
+
+    def _send(self, frame: tuple, op_key: tuple | None = None) -> None:
+        # a send racing a failover retries while the recv loop replaces
+        # self._sock in the background — EXCEPT when the op it belongs to
+        # was abandoned by that failover: delivering a pre-failover
+        # collective frame to the promoted deputy would plant an op_key no
+        # other rank will ever complete
+        deadline = time.monotonic() + 60.0
+        while True:
+            try:
+                with self._send_lock:
+                    if op_key is not None:
+                        with self._results_lock:
+                            abandoned = op_key not in self._pending_sends
+                        if abandoned:
+                            raise ControlPlaneFailover(
+                                f'rank {self.rank}: collective abandoned '
+                                f'by a control-plane failover before send')
+                    _send_frame(self._sock, frame)
+                return
+            except OSError:
+                if self._closed.is_set() or time.monotonic() >= deadline:
+                    raise
+                self._reconnected.wait(timeout=0.5)
 
     def _recv_loop(self) -> None:
         while not self._closed.is_set():
             try:
                 frame = _recv_frame(self._sock)
             except OSError:
-                return
+                frame = None
             if frame is None:
+                if self._closed.is_set() or not self._failover():
+                    return
+                continue
+            if frame[0] == 'standby':
+                # we dialed a standby deputy while the primary lives (our
+                # link flaked, not the primary): go back to the primary and
+                # replay the frames the deputy deterministically dropped
+                if self._redial(0, replay=True, connect_timeout=5.0):
+                    continue
+                # primary unreachable after all: the deputy will promote —
+                # return to it (the 0.2s same-index pause gives it time)
+                if self._redial(self._active, replay=True):
+                    continue
                 return
             kind = frame[0]
             if kind == 'event':
@@ -465,23 +618,77 @@ class TcpTransport:
                 if self.on_control is not None:
                     self.on_control(frame)
 
+    def _failover(self) -> bool:
+        """The active hub died: fail in-flight collectives (their delivery
+        state is unknowable — see :class:`ControlPlaneFailover`) and switch
+        to the next address in the failover list."""
+        with self._results_lock:
+            self._pending_sends.clear()
+            boxes = list(self._results.values())
+        for box in boxes:
+            box.put(_FAILED_OVER)
+        if len(self._addresses) == 1:
+            return False
+        return self._redial((self._active + 1) % len(self._addresses),
+                            replay=False)
+
+    def _redial(self, index: int, *, replay: bool,
+                connect_timeout: float = 30.0) -> bool:
+        self._reconnected.clear()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if index == self._active:     # redialing the same address: brief
+            time.sleep(0.2)           # pause so a dead hub cannot hot-loop
+        try:
+            sock = self._dial(self._addresses[index],
+                              connect_timeout=connect_timeout)
+        except OSError:
+            return False
+        with self._send_lock:
+            self._sock = sock
+        self._active = index
+        self._reconnected.set()
+        if replay:
+            with self._results_lock:
+                pending = list(self._pending_sends.values())
+            for frame in pending:
+                try:
+                    self._send(frame)
+                except OSError:
+                    return False
+        return True
+
     def _heartbeat_loop(self, interval: float) -> None:
         while not self._closed.wait(interval):
             try:
                 self._send(('hb',))
             except OSError:
-                return
+                continue   # a failover may be in progress; retry next beat
 
     def _collective(self, kind: str, op: str, value: Any, timeout: float) -> Any:
         # Same call order on every rank => the same per-kind sequence number
         # identifies the same collective everywhere.
         op_key = (kind, op, next(self._counter))
+        frame = (kind, op_key, value)
         with self._results_lock:
             box = self._results.setdefault(op_key, queue.Queue())
-        self._send((kind, op_key, value))
-        result = box.get(timeout=timeout)
-        with self._results_lock:
-            self._results.pop(op_key, None)
+            self._pending_sends[op_key] = frame
+        try:
+            self._send(frame, op_key=op_key)
+            result = box.get(timeout=timeout)
+        finally:
+            # timeouts and send failures must not leak the box or leave a
+            # stale frame eligible for a later redial replay
+            with self._results_lock:
+                self._results.pop(op_key, None)
+                self._pending_sends.pop(op_key, None)
+        if result is _FAILED_OVER:
+            raise ControlPlaneFailover(
+                f'rank {self.rank}: the active hub died while this '
+                f'collective was in flight; resynchronize at a safe point '
+                f'(collectives after the failover complete on the deputy)')
         if result is _REJECTED:
             raise RuntimeError(
                 f'rank {self.rank} is excluded from collectives (it crashed, '
@@ -494,6 +701,9 @@ class TcpTransport:
         self._channels[channel] = callback
 
     def send_event(self, channel: str, message: Any) -> None:
+        """Fire-and-forget. Delivery is at-most-once across a control-plane
+        failover window (no event ack protocol, by design — events are
+        observability; collectives are the agreement primitive)."""
         self._send(('event', channel, message))
 
     def allreduce(self, value: Any, op: str = 'and', timeout: float = 300.0) -> Any:
@@ -519,19 +729,34 @@ class TcpTransport:
 
 def connect(address: tuple, world: World,
             heartbeat_interval: float | None = None,
-            heartbeat_timeout: float | None = None) -> tuple[TcpTransport, Hub | None]:
+            heartbeat_timeout: float | None = None,
+            deputy_address: tuple | None = None) -> tuple[TcpTransport, Hub | None]:
     """Attach this host to the control plane; the primary also hosts the Hub.
 
-    Returns ``(transport, hub)`` — ``hub`` is None off-primary. Typical
-    wiring: primary calls with ``port`` fixed in ``address``; others connect
-    to it.
+    Returns ``(transport, hub)`` — ``hub`` is the primary Hub on rank 0,
+    the standby deputy Hub on rank 1 when ``deputy_address`` is given
+    (a concrete ``(host, port)`` every rank can compute), else None.
+    With a deputy, transports dial ``[address, deputy_address]`` and
+    survive primary-hub loss: the deputy promotes when its hub-to-hub
+    link to the primary dies, clients fail over, and only collectives
+    that were in flight at the instant of the loss fail (with
+    :class:`ControlPlaneFailover`).
     """
     hub = None
     if world.is_primary:
         hub = Hub(world.process_count, host=address[0], port=address[1],
                   heartbeat_timeout=heartbeat_timeout)
         address = hub.address
-    transport = TcpTransport(address, world.process_index, world.process_count,
+    if deputy_address is not None and world.process_count > 1:
+        if world.process_index == 1:
+            hub = Hub(world.process_count, host=deputy_address[0],
+                      port=deputy_address[1],
+                      heartbeat_timeout=heartbeat_timeout,
+                      standby_of=tuple(address))
+        dial = [tuple(address), tuple(deputy_address)]
+    else:
+        dial = tuple(address)
+    transport = TcpTransport(dial, world.process_index, world.process_count,
                              heartbeat_interval=heartbeat_interval)
     return transport, hub
 

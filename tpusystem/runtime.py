@@ -61,10 +61,29 @@ def _control_address(coordinator: str | None,
     """
     spec = os.environ.get('TPUSYSTEM_CONTROL')
     if spec:
-        host, separator, port = spec.rpartition(':')
-        if not separator:
-            raise ValueError(f'TPUSYSTEM_CONTROL must be host:port, got {spec!r}')
-        return host, int(port)
+        return _parse_hostport(spec, 'TPUSYSTEM_CONTROL')
+    return _coordinator_derived(coordinator, control_port)
+
+
+def _parse_hostport(spec: str, source: str) -> tuple[str, int]:
+    host, separator, port = spec.rpartition(':')
+    if not separator:
+        raise ValueError(f'{source} must be host:port, got {spec!r}')
+    return host, int(port)
+
+
+def _deputy_address() -> tuple[str, int] | None:
+    """``TPUSYSTEM_CONTROL_DEPUTY=host:port`` enables hub redundancy: rank 1
+    hosts a standby hub there and every transport fails over to it if the
+    primary hub's host dies (see ``multihost.connect``)."""
+    spec = os.environ.get('TPUSYSTEM_CONTROL_DEPUTY')
+    if not spec:
+        return None
+    return _parse_hostport(spec, 'TPUSYSTEM_CONTROL_DEPUTY')
+
+
+def _coordinator_derived(coordinator: str | None,
+                         control_port: int | None) -> tuple[str, int]:
     if coordinator:
         host, separator, port = coordinator.rpartition(':')
         if not separator:
@@ -111,7 +130,8 @@ class Runtime:
             self.transport, self.hub = multihost.connect(
                 address, self.world,
                 heartbeat_interval=heartbeat,
-                heartbeat_timeout=4 * heartbeat if heartbeat else None)
+                heartbeat_timeout=4 * heartbeat if heartbeat else None,
+                deputy_address=_deputy_address())
         else:
             self.transport: Loopback | TcpTransport = Loopback()
         self.producer = DistributedProducer(self.transport)
