@@ -1,0 +1,61 @@
+"""Speculative vs plain greedy decode on one chip.
+
+Speedup = f(draft agreement rate, draft/target cost ratio), so untrained
+models measure only the overhead floor (~0.7x: every iteration pays
+K+1 draft steps + 1 verify to emit one token). For a real number, target
+and draft are first TRAINED on the same bigram corpus (SyntheticTokens)
+until they agree on greedy continuations. Exactness caveat: output
+equality with plain decode is bit-exact in float32 (pinned by
+tests/test_generate.py); under bfloat16 argmax tie-breaks may differ
+between the one-token and windowed paths.
+"""
+import sys, time, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+import jax, jax.numpy as jnp, numpy as np
+from functools import partial
+from tpusystem.data import SyntheticTokens
+from tpusystem.models import GPT2
+from tpusystem.train import (AdamW, NextTokenLoss, build_train_step,
+                             flax_apply, generate, init_state,
+                             speculative_generate)
+
+VOCAB, SEQ, STEPS = 256, 64, 128
+
+def train(module, steps=300):
+    dataset = SyntheticTokens(samples=64 * 16, sequence_length=SEQ,
+                              vocab_size=VOCAB)
+    tokens = jnp.asarray(np.stack([dataset[i][0] for i in range(64)]))
+    state = init_state(module, AdamW(lr=1e-3), tokens[:1])
+    step = build_train_step(flax_apply(module), NextTokenLoss(),
+                            AdamW(lr=1e-3), jit=False)
+    @partial(jax.jit, donate_argnums=0)
+    def run(state):
+        return jax.lax.fori_loop(
+            0, steps, lambda i, st: step(st, tokens, tokens)[0], state)
+    state = run(state)
+    jax.tree.leaves(state.params)[0].block_until_ready()
+    return state.params
+
+target = GPT2(vocab_size=VOCAB, layers=8, dim=512, heads=8, max_seq=512,
+              dropout=0.0, dtype='float32')  # f32: decode is overhead-bound
+              # and exact equality with plain decode is then guaranteed
+draft = GPT2(vocab_size=VOCAB, layers=1, dim=128, heads=2, max_seq=512,
+             dropout=0.0, dtype='float32')
+params = train(target)
+draft_params = train(draft)
+prompt = jnp.asarray(np.stack([SyntheticTokens(
+    samples=1, sequence_length=16, vocab_size=VOCAB, seed=99)[0][0]]))
+
+def timed(fn):
+    np.asarray(fn())                         # compile
+    start = time.perf_counter(); out = np.asarray(fn())
+    return out, STEPS / (time.perf_counter() - start)
+
+plain, plain_tps = timed(lambda: generate(target, params, prompt, steps=STEPS))
+for K in (3, 5, 7):
+    spec, spec_tps = timed(lambda: speculative_generate(
+        target, params, prompt, steps=STEPS, draft_module=draft,
+        draft_params=draft_params, speculate=K))
+    exact = bool(np.array_equal(spec, plain))
+    print(f'K={K}: plain {plain_tps:.0f} tok/s, speculative {spec_tps:.0f} '
+          f'tok/s ({spec_tps/plain_tps:.2f}x), exact match {exact}')

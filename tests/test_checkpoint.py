@@ -120,3 +120,16 @@ def test_repository_auto_epoch_increments(tmp_path, state):
         assert repository.latest(aggregate) == 7
     finally:
         repository.close()
+
+
+def test_gc_keeps_window_plus_periodic(tmp_path, state):
+    """max_to_keep bounds the rolling window while keep_every pins every
+    Nth epoch forever — the GC policy for long runs (ROADMAP robustness)."""
+    with Checkpointer(tmp_path, async_save=False, max_to_keep=2,
+                      keep_every=4) as ckpt:
+        for epoch in range(10):
+            ckpt.save('m', epoch, state)
+        kept = ckpt.epochs('m')
+    assert set(kept) >= {0, 4, 8}            # periodic pins survive
+    assert set(kept) >= {8, 9}               # the rolling window survives
+    assert 5 not in kept and 6 not in kept   # evicted between pins

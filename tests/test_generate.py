@@ -115,3 +115,64 @@ def test_decode_clone_strips_training_settings(prompt):
     params = module.init(jax.random.PRNGKey(0), prompt)['params']
     out = generate(module, params, prompt, steps=2)
     assert out.shape == (2, 9)
+
+
+@pytest.mark.slow
+def test_speculative_decode_equals_greedy_regardless_of_draft():
+    """The speculative output must be EXACTLY the target's greedy decode —
+    the draft only affects speed. Pinned with a random-weight draft (worst
+    case: near-zero acceptance) and with the target itself as draft (best
+    case: full acceptance), across speculate widths."""
+    from tpusystem.train import generate, speculative_generate
+    target = gpt2_tiny(dtype='float32', max_seq=128)
+    draft = gpt2_tiny(dtype='float32', layers=1, dim=32, heads=2, max_seq=128)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (2, 8)), jnp.int32)
+    params = target.init(jax.random.PRNGKey(0), tokens)['params']
+    draft_params = draft.init(jax.random.PRNGKey(9), tokens)['params']
+
+    reference = np.asarray(generate(target, params, tokens, steps=24))
+    for speculate in (1, 3, 5):
+        out = speculative_generate(
+            target, params, tokens, steps=24, draft_module=draft,
+            draft_params=draft_params, speculate=speculate)
+        np.testing.assert_array_equal(np.asarray(out), reference)
+
+    # perfect draft: the target drafting for itself accepts everything
+    out = speculative_generate(
+        target, params, tokens, steps=24, draft_module=target,
+        draft_params=params, speculate=4)
+    np.testing.assert_array_equal(np.asarray(out), reference)
+
+
+def test_speculative_decode_validates_capacity_and_args():
+    from tpusystem.train import speculative_generate
+    target = gpt2_tiny(dtype='float32', max_seq=32)
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    params = target.init(jax.random.PRNGKey(0), tokens)['params']
+    with pytest.raises(ValueError, match='capacity'):
+        speculative_generate(target, params, tokens, steps=16,
+                             draft_module=target, draft_params=params,
+                             speculate=4)
+    with pytest.raises(ValueError, match='speculate'):
+        speculative_generate(target, params, tokens, steps=4,
+                             draft_module=target, draft_params=params,
+                             speculate=0)
+
+
+@pytest.mark.slow
+def test_speculative_decode_llama_rotary_positions():
+    """Cursor rewind must also restore Llama's rotary positions (read from
+    the per-layer cache index)."""
+    from tpusystem.train import generate, speculative_generate
+    target = llama_tiny(dtype='float32', max_seq=128)
+    draft = llama_tiny(dtype='float32', layers=1, ffn_dim=64, max_seq=128)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, 256, (2, 8)), jnp.int32)
+    params = target.init(jax.random.PRNGKey(1), tokens)['params']
+    draft_params = draft.init(jax.random.PRNGKey(7), tokens)['params']
+    reference = np.asarray(generate(target, params, tokens, steps=20))
+    out = speculative_generate(target, params, tokens, steps=20,
+                               draft_module=draft, draft_params=draft_params,
+                               speculate=3)
+    np.testing.assert_array_equal(np.asarray(out), reference)

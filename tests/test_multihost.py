@@ -705,3 +705,40 @@ class TestDeputy:
                 transport.close()
             primary.close()
             deputy.close()
+
+    def test_rank_lost_before_failover_degrades_on_deputy(self):
+        """The primary's exclusion state dies with it; the promoted deputy
+        seeds liveness for never-connected ranks so its heartbeat monitor
+        excludes them and survivors' collectives degrade instead of
+        deadlocking on a ghost."""
+        import threading
+        primary = Hub(3, heartbeat_timeout=0.4)
+        deputy = Hub(3, standby_of=primary.address, heartbeat_timeout=0.4)
+        transports = [
+            TcpTransport([primary.address, deputy.address], rank, 3,
+                         heartbeat_interval=0.05)
+            for rank in range(3)]
+        try:
+            assert wait_until(lambda: len(primary._clients) == 3)
+            # rank 2 crashes and is excluded on the primary
+            transports[2]._sock.shutdown(socket.SHUT_RDWR)
+            assert wait_until(lambda: 2 in primary._excluded)
+            transports[2].close()     # it stays gone (no deputy dialing)
+            primary.close()           # then the star center dies
+            assert wait_until(lambda: not deputy.is_standby, timeout=10)
+
+            results = {}
+
+            def contribute(rank):
+                results[rank] = transports[rank].allreduce(rank, op='sum',
+                                                           timeout=20)
+            threads = [threading.Thread(target=contribute, args=(r,))
+                       for r in (0, 1)]
+            for t in threads: t.start()
+            for t in threads: t.join(timeout=25)
+            assert results == {0: 1, 1: 1}     # degraded to the survivors
+            assert 2 in deputy._excluded
+        finally:
+            for transport in transports:
+                transport.close()
+            deputy.close()

@@ -64,6 +64,9 @@ with Runtime(coordinator=coordinator, num_processes=nprocs, process_id=rank,
 
     runtime.producer.wire(Ping)
     runtime.producer.register(consumer, primary_only=True)
+    # rendezvous BEFORE dispatching: events are fire-and-forget, so a
+    # dispatch racing another rank's hub registration would be dropped
+    runtime.barrier()
     if rank == nprocs - 1:
         runtime.producer.dispatch(Ping(sender=rank))
     runtime.barrier()                    # checkpoint-style rendezvous

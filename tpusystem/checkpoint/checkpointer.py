@@ -48,9 +48,15 @@ class Checkpointer:
     """
 
     def __init__(self, root: str | pathlib.Path, *, max_to_keep: int | None = 3,
+                 keep_every: int | None = None,
                  async_save: bool = True) -> None:
+        """``max_to_keep`` bounds the rolling window; ``keep_every`` pins
+        every Nth epoch forever in addition (GC policy: a long run keeps
+        recent checkpoints for resume plus periodic ones for analysis
+        /rollback instead of losing all history to the window)."""
         self.root = pathlib.Path(root).absolute()
         self.max_to_keep = max_to_keep
+        self.keep_every = keep_every
         self.async_save = async_save
         self._managers: dict[str, ocp.CheckpointManager] = {}
 
@@ -58,6 +64,7 @@ class Checkpointer:
         if identity not in self._managers:
             options = ocp.CheckpointManagerOptions(
                 max_to_keep=self.max_to_keep,
+                keep_period=self.keep_every,
                 enable_async_checkpointing=self.async_save)
             self._managers[identity] = ocp.CheckpointManager(
                 self.root / identity, options=options)

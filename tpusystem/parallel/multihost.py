@@ -249,6 +249,17 @@ class Hub:
             # link died: confirm by redial (short window) before promoting
             sock = dial(time.monotonic() + 3.0)
         if not self._closed.is_set():
+            # The primary's exclusion state died with it. Seed liveness for
+            # every rank not yet connected here: ranks lost BEFORE the
+            # failover never dial in, the heartbeat monitor marks them
+            # stale, and collectives degrade to the survivors instead of
+            # deadlocking on ghosts. (Without heartbeats there is no
+            # failure detection at all — same contract as the primary.)
+            with self._locks:
+                now = time.monotonic()
+                for rank in range(self.size):
+                    if rank not in self._clients:
+                        self._last_seen.setdefault(rank, now)
             self._standby.clear()       # promote
             self._complete_satisfied()
 
@@ -521,6 +532,7 @@ class TcpTransport:
         self._counter = itertools.count()
         self._closed = threading.Event()
         self._reconnected = threading.Event()
+        self._dead = False       # set when every failover avenue is spent
         self._sock = self._dial(self._addresses[0], connect_timeout)
         self._reconnected.set()
         self._threads = [threading.Thread(target=self._recv_loop, daemon=True)]
@@ -569,9 +581,12 @@ class TcpTransport:
                     _send_frame(self._sock, frame)
                 return
             except OSError:
-                if self._closed.is_set() or time.monotonic() >= deadline:
+                if (self._closed.is_set() or self._dead
+                        or time.monotonic() >= deadline):
                     raise
                 self._reconnected.wait(timeout=0.5)
+                if self._dead:
+                    raise
 
     def _recv_loop(self) -> None:
         while not self._closed.is_set():
@@ -580,7 +595,10 @@ class TcpTransport:
             except OSError:
                 frame = None
             if frame is None:
-                if self._closed.is_set() or not self._failover():
+                if self._closed.is_set():
+                    return
+                if not self._failover():
+                    self._abandon()
                     return
                 continue
             if frame[0] == 'standby':
@@ -593,6 +611,7 @@ class TcpTransport:
                 # return to it (the 0.2s same-index pause gives it time)
                 if self._redial(self._active, replay=True):
                     continue
+                self._abandon()
                 return
             kind = frame[0]
             if kind == 'event':
@@ -631,6 +650,17 @@ class TcpTransport:
             return False
         return self._redial((self._active + 1) % len(self._addresses),
                             replay=False)
+
+    def _abandon(self) -> None:
+        """Every failover avenue is spent: fail anything waiting (typed —
+        callers see ControlPlaneFailover, not a raw timeout) and make
+        future sends raise immediately instead of retrying a dead link."""
+        self._dead = True
+        with self._results_lock:
+            self._pending_sends.clear()
+            boxes = list(self._results.values())
+        for box in boxes:
+            box.put(_FAILED_OVER)
 
     def _redial(self, index: int, *, replay: bool,
                 connect_timeout: float = 30.0) -> bool:

@@ -5,11 +5,11 @@ from tpusystem.train.optim import SGD, Adam, AdamW, Optimizer
 from tpusystem.train.losses import (ChunkedNextTokenLoss, CrossEntropyLoss,
                                     MSELoss, NextTokenLoss, WithAuxLoss)
 from tpusystem.train.metrics import Accuracy, Mean, Metric, Perplexity, TopKAccuracy
-from tpusystem.train.generate import generate
+from tpusystem.train.generate import generate, speculative_generate
 
 __all__ = ['TrainState', 'build_train_step', 'build_1f1b_train_step', 'build_eval_step', 'flax_apply',
            'init_state', 'Optimizer', 'SGD', 'Adam', 'AdamW',
            'CrossEntropyLoss', 'MSELoss', 'NextTokenLoss', 'ChunkedNextTokenLoss',
            'WithAuxLoss',
            'Mean', 'Accuracy', 'TopKAccuracy', 'Perplexity', 'Metric',
-           'generate']
+           'generate', 'speculative_generate']

@@ -75,6 +75,140 @@ def generate(module, params, prompt, *, steps: int,
     return run(params, prompt, rng)
 
 
+def speculative_generate(module, params, prompt, *, steps: int,
+                         draft_module, draft_params, speculate: int = 4):
+    """Greedy generation accelerated by a draft model (speculative decoding).
+
+    The draft proposes ``speculate`` tokens autoregressively (cheap model,
+    cheap steps); the target verifies them in ONE forward over the
+    proposed window and accepts the longest prefix that matches its own
+    greedy choices, emitting one extra corrected token — so each target
+    forward yields between 1 and ``speculate + 1`` tokens. **Output is
+    exactly the target's greedy decode regardless of draft quality** (a
+    bad draft only costs speed); both KV caches rewind their cursors to
+    the accepted prefix each round.
+
+    Batched prompts advance by the *minimum* acceptance across the batch
+    (per-element cursors would need per-row cache writes), so speedup is
+    largest at small batch. Greedy only — temperature sampling needs
+    rejection-sampling acceptance, not shipped yet.
+
+    Returns int32 ``[batch, prompt_len + steps]`` like :func:`generate`.
+    """
+    if steps < 1:
+        raise ValueError(f'steps must be >= 1, got {steps}')
+    if speculate < 1:
+        raise ValueError(f'speculate must be >= 1, got {speculate}')
+    decoder, drafter = _decoder(module), _decoder(draft_module)
+    needed = prompt.shape[1] + steps + speculate + 1
+    capacity = min(decoder.max_seq, drafter.max_seq)
+    if needed > capacity:
+        raise ValueError(
+            f'prompt + steps + speculate + 1 = {needed} exceeds the cache '
+            f'capacity max_seq={capacity} (verification overshoots by up to '
+            f'speculate tokens before rewinding)')
+    try:
+        run = _compiled_speculative(decoder, drafter, steps, speculate)
+    except TypeError:       # unhashable module field
+        run = _build_speculative(decoder, drafter, steps, speculate)
+    return run(params, draft_params, prompt)
+
+
+def _rewind(cache, cursor):
+    """Set every cache cursor back to ``cursor`` — rows beyond it are
+    garbage from rejected speculation, masked out by the cursor-based
+    attention mask and overwritten by the next accepted tokens. Covers the
+    per-layer KV cursors (``index`` — also what Llama's rotary reads) and
+    GPT-2's learned-position offset (``position``)."""
+    cursors = (jax.tree_util.DictKey('index'),
+               jax.tree_util.DictKey('position'))
+
+    def fix(path, leaf):
+        if path[-1] in cursors:
+            return jnp.asarray(cursor, leaf.dtype)
+        return leaf
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.cache
+def _compiled_speculative(decoder, drafter, steps: int, speculate: int):
+    return _build_speculative(decoder, drafter, steps, speculate)
+
+
+def _build_speculative(decoder, drafter, steps: int, speculate: int):
+    K = speculate
+
+    @jax.jit
+    def run(params, draft_params, prompt):
+        batch, prefix = prompt.shape
+        tlogits, tstate = decoder.apply({'params': params}, prompt,
+                                        mutable=['cache'])
+        _, dstate = drafter.apply({'params': draft_params}, prompt,
+                                  mutable=['cache'])
+        token = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+        # padded so a full window write at the last offset stays in bounds
+        out = jnp.zeros((batch, steps + K + 1), jnp.int32)
+        out = out.at[:, 0].set(token)
+
+        def cond(carry):
+            return carry[0] < steps
+
+        def body(carry):
+            produced, cursor, token, out, tcache, dcache = carry
+
+            def draft_step(state, _):
+                cache, tok = state
+                logits, updated = drafter.apply(
+                    {'params': draft_params, 'cache': cache}, tok[:, None],
+                    mutable=['cache'])
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (updated['cache'], nxt), nxt
+
+            # K+1 steps: the last consumes d_K so the draft cache holds its
+            # KV when every draft is accepted (the extra proposal is unused)
+            (dcache, _), drafts = jax.lax.scan(
+                draft_step, (dcache, token), None, length=K + 1)
+            drafts = jnp.moveaxis(drafts, 0, 1)[:, :K]   # [B, K]
+
+            # one target forward over the whole proposed window
+            window = jnp.concatenate([token[:, None], drafts], axis=1)
+            vlogits, tupdated = decoder.apply(
+                {'params': params, 'cache': tcache}, window,
+                mutable=['cache'])
+            candidates = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+
+            # accept the longest draft prefix matching the target's greedy
+            # choices; the whole batch advances by the minimum acceptance
+            matches = (drafts == candidates[:, :K]).astype(jnp.int32)
+            accepted = jnp.min(jnp.sum(jnp.cumprod(matches, axis=1), axis=1))
+
+            # emit accepted drafts plus the target's correction token
+            correction = jax.lax.dynamic_index_in_dim(
+                candidates, accepted, axis=1, keepdims=False)
+            positions = jnp.arange(K + 1)[None, :]
+            emitted = jnp.where(
+                positions < accepted,
+                jnp.pad(drafts, ((0, 0), (0, 1))),
+                jnp.where(positions == accepted, correction[:, None], 0))
+            out = jax.lax.dynamic_update_slice(out, emitted, (0, produced))
+
+            produced = produced + accepted + 1
+            cursor = cursor + accepted + 1
+            token = jax.lax.dynamic_index_in_dim(
+                emitted, accepted, axis=1, keepdims=False)
+            return (produced, cursor,
+                    token, out,
+                    _rewind(tupdated['cache'], cursor),
+                    _rewind(dcache, cursor))
+
+        carry = (jnp.int32(1), jnp.int32(prefix), token, out,
+                 tstate['cache'], dstate['cache'])
+        _, _, _, out, _, _ = jax.lax.while_loop(cond, body, carry)
+        return jnp.concatenate([prompt, out[:, :steps]], axis=1)
+
+    return run
+
+
 @functools.cache
 def _compiled(decoder, steps: int, temperature: float):
     return _build(decoder, steps, temperature)
