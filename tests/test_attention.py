@@ -299,3 +299,31 @@ def test_ring_einsum_inner_fallback_matches(qkv):
                                   inner='einsum')
     np.testing.assert_allclose(np.asarray(reference), np.asarray(sharded),
                                atol=2e-5)
+
+
+def test_flash_gqa_gradients_accumulate_over_group():
+    """GQA in-kernel: dK/dV for one KV head must accumulate over every
+    query head in its group (the backward sweeps (member, q block) pairs),
+    matching the broadcast-KV reference exactly."""
+    rng = np.random.default_rng(13)
+    q = jnp.asarray(rng.normal(size=(2, 64, 8, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 64, 2, 16)), jnp.float32)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_kv=32,
+                              interpret=True)
+        return jnp.mean(out ** 2)
+
+    def loss_reference(q, k, v):
+        from tpusystem.ops.attention import repeat_kv_heads
+        kk, vv = repeat_kv_heads(q, k, v)
+        out = dot_product_attention(q, kk, vv, causal=True)
+        # dK/dV of the broadcast reference sum over the group implicitly
+        return jnp.mean(out ** 2)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_reference, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(got, want):
+        assert a.shape == b.shape
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)

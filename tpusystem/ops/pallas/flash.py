@@ -146,11 +146,14 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, dk_scr, dv_scr,
                       *, scale: float, causal: bool,
-                      block_q: int, block_kv: int):
-    kv_idx, q_idx = pl.program_id(1), pl.program_id(2)
-    q_steps = pl.num_programs(2)
+                      block_q: int, block_kv: int, q_steps: int):
+    # the innermost grid dim sweeps (group member, q block) pairs under
+    # GQA: the q-block index for causal masking is its q_steps remainder,
+    # and dk/dv accumulate across the whole sweep
+    kv_idx, sweep = pl.program_id(1), pl.program_id(2)
+    q_idx = sweep % q_steps
 
-    @pl.when(q_idx == 0)
+    @pl.when(sweep == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -174,7 +177,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dscores.astype(query.dtype), query, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(q_idx == q_steps - 1)
+    @pl.when(sweep == pl.num_programs(2) - 1)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -208,8 +211,14 @@ def _block_sizes(seq_q: int, seq_kv: int, block_q: int, block_kv: int):
     return block_q, block_kv
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
-    """q/k/v: [BH, S, D]. Returns (out, residuals)."""
+def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
+               group=1):
+    """q: [B*Hq, S, D]; k/v: [B*Hkv, S, D] with Hq = Hkv * group.
+
+    GQA lives entirely in the index maps: query row ``i`` reads KV row
+    ``i // group`` (b-major head layout makes that exact), so grouped KV
+    is never materialized at the query head count. Returns
+    (out, residuals)."""
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
     grid = (bh, seq_q // block_q, seq_kv // block_kv)
@@ -221,8 +230,10 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((1, block_kv, head_dim),
+                         lambda i, j, k_: (i // group, k_, 0)),
+            pl.BlockSpec((1, block_kv, head_dim),
+                         lambda i, j, k_: (i // group, k_, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
@@ -242,8 +253,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, residuals,
-                    grad_out, grad_lse):
+def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
+                    residuals, grad_out, grad_lse):
     """Backward for :func:`_flash_lse`. ``grad_lse`` (bh, seq_q) is the
     cotangent of the logsumexp output (ring attention merges chunk results
     by lse, so gradient flows into it; plain ``flash_attention`` discards
@@ -267,8 +278,10 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, residuals,
         grid=(bh, seq_q // block_q, seq_kv // block_kv),
         in_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
-            pl.BlockSpec((1, block_kv, head_dim), lambda i, j, k_: (i, k_, 0)),
+            pl.BlockSpec((1, block_kv, head_dim),
+                         lambda i, j, k_: (i // group, k_, 0)),
+            pl.BlockSpec((1, block_kv, head_dim),
+                         lambda i, j, k_: (i // group, k_, 0)),
             pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
             pl.BlockSpec((1, block_q, STATS), lambda i, j, k_: (i, j, 0)),
             pl.BlockSpec((1, block_q, STATS), lambda i, j, k_: (i, j, 0)),
@@ -279,19 +292,24 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, residuals,
         interpret=interpret,
     )(q, k, v, grad_out, lse, delta)
 
+    q_steps = seq_q // block_q
     dkv_kernel = functools.partial(
         _flash_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv)
+        block_q=block_q, block_kv=block_kv, q_steps=q_steps)
+    # grid dim 0 walks KV rows; the innermost dim sweeps every (group
+    # member, q block) pair so one kv head's dk/dv accumulates over all
+    # the query heads that shared it
+    row = lambda i, k_, j: (i * group + j // q_steps, j % q_steps, 0)
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, seq_kv // block_kv, seq_q // block_q),
+        grid=(bh // group, seq_kv // block_kv, q_steps * group),
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda i, k_, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, head_dim), row),
             pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
             pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
-            pl.BlockSpec((1, block_q, head_dim), lambda i, k_, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, STATS), lambda i, k_, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q, STATS), lambda i, k_, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q, head_dim), row),
+            pl.BlockSpec((1, block_q, STATS), row),
+            pl.BlockSpec((1, block_q, STATS), row),
         ],
         out_specs=[
             pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
@@ -310,25 +328,26 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, residuals,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, causal, scale, block_q, block_kv, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, causal, scale, block_q, block_kv, interpret, group):
     (out, lse), _ = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv,
-                                   interpret)
+                                   interpret, group)
     return out, lse
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
+                   group):
     out, residuals = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
-                                interpret)
+                                interpret, group)
     lse = residuals[4][..., 0]                                # (bh, seq_q)
     return (out, lse), residuals
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_kv, interpret, residuals,
-                   grads):
+def _flash_lse_bwd(causal, scale, block_q, block_kv, interpret, group,
+                   residuals, grads):
     grad_out, grad_lse = grads
     return _flash_bwd_impl(causal, scale, block_q, block_kv, interpret,
-                           residuals, grad_out, grad_lse)
+                           group, residuals, grad_out, grad_lse)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -375,12 +394,19 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
         interpret = jax.default_backend() not in ('tpu', 'axon')
 
     batch, seq_q, q_heads, head_dim = query.shape
-    from tpusystem.ops.attention import repeat_kv_heads
-    key, value = repeat_kv_heads(query, key, value)
+    kv_heads = key.shape[2]
+    assert q_heads % kv_heads == 0, (
+        f'query heads ({q_heads}) must be a multiple of KV heads '
+        f'({kv_heads}) for grouped-query attention')
+    # GQA stays grouped: the kernel maps each query head to its KV head via
+    # the block index maps, so KV is never materialized q_heads wide
+    group = q_heads // kv_heads
     scale = scale if scale is not None else head_dim ** -0.5
 
     sizes = _block_sizes(seq_q, key.shape[1], block_q, block_kv)
     if sizes is None:
+        from tpusystem.ops.attention import repeat_kv_heads
+        key, value = repeat_kv_heads(query, key, value)
         return _xla_attention_lse(query, key, value, causal=causal, scale=scale)
     block_q, block_kv = sizes
 
@@ -388,7 +414,7 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
         return tensor.transpose(0, 2, 1, 3).reshape(-1, tensor.shape[1], head_dim)
 
     out, lse = _flash_lse(to_bh(query), to_bh(key), to_bh(value),
-                          causal, scale, block_q, block_kv, interpret)
+                          causal, scale, block_q, block_kv, interpret, group)
     out = out.reshape(batch, q_heads, seq_q, head_dim).transpose(0, 2, 1, 3)
     lse = lse.reshape(batch, q_heads, seq_q).transpose(0, 2, 1)
     return out, lse
