@@ -5,17 +5,20 @@ this module supplies the TPU-native design: experts live as one stacked
 weight tensor with a leading ``experts`` dimension sharded over the
 ``expert`` mesh axis. Token routing has two formulations behind one layer:
 
-* **sparse** (single-shard default): sort/segment dispatch — a stable
-  argsort by expert id gives each assignment its position-in-expert, and
-  scatter/gather moves only the O(tokens·k) selected rows. This is the
-  scalable path: the dense tensors are O(tokens·experts·capacity) ≈
-  O(tokens²·k) in both memory and FLOPs.
-* **dense** (expert-sharded meshes): one-hot dispatch/combine einsums (the
-  Switch-Transformer/GSPMD formulation). With the dispatched activations
-  sharding-constrained to the expert axis, XLA inserts the all-to-alls
-  over ICI itself — no hand-written collective. Neither the global argsort
-  nor the slot scatter partitions along the token axis, so
-  ``dispatch='auto'`` keeps the dense form on any multi-device mesh.
+* **sparse**: sort/segment dispatch — a stable argsort by expert id
+  gives each assignment its position-in-expert, and scatter/gather moves
+  only the O(tokens·k) selected rows (the dense tensors are
+  O(tokens·experts·capacity) ≈ O(tokens²·k) in memory and FLOPs).
+  Single-shard it runs directly; on multi-device meshes it runs inside
+  ``shard_map`` with token rows sharded over (data, fsdp, seq, expert)
+  and a regular differentiable ``all_to_all`` carrying each sender's
+  fixed per-expert quota to the expert's owner — SURVEY §2.4's
+  ragged-style exchange, made static-shaped by quota padding.
+* **dense**: one-hot dispatch/combine einsums (the Switch/GSPMD
+  formulation); the partitioner shards them freely and inserts the
+  collectives itself. ``dispatch='auto'`` falls back here when the
+  sharded-sparse preconditions fail (indivisible rows/experts, model-axis
+  TP inside experts).
 
 Capacity model: each expert processes at most
 ``capacity = round(k * tokens / experts * capacity_factor)`` tokens per
@@ -154,25 +157,44 @@ class MoEMLP(nn.Module):
         w2 = self.param('w2', init, (self.experts, hidden_dim, dim), jnp.float32)
         b2 = self.param('b2', nn.initializers.zeros, (self.experts, dim), jnp.float32)
 
+        # 'sparse' is the O(tokens·k) sort/scatter path. Single-shard it
+        # runs directly; on a multi-device mesh it runs inside shard_map
+        # with token rows sharded over (data, fsdp, expert) and a regular
+        # all_to_all moving each sender's per-expert quota to the expert's
+        # owner (_sharded_sparse — SURVEY §2.4's ragged-style dispatch,
+        # made exchangeable with static shapes by fixed per-sender
+        # quotas). 'auto' falls back to the dense one-hot einsums when the
+        # sharded preconditions don't hold (divisibility, unsharded model
+        # axis); explicit 'sparse' raises instead of silently degrading.
+        mode = self.dispatch
+        if mode == 'auto':
+            if self.mesh is None or self.mesh.size == 1:
+                mode = 'sparse'
+            else:
+                problem = self._sharded_sparse_blocker(tokens)
+                mode = 'dense' if problem else 'sparse_sharded'
+        elif mode == 'sparse':
+            if self.mesh is not None and self.mesh.size > 1:
+                problem = self._sharded_sparse_blocker(tokens)
+                if problem:
+                    raise ValueError(
+                        f'dispatch=sparse on a multi-device mesh: {problem} '
+                        f"(use dispatch='auto' to fall back to dense)")
+                mode = 'sparse_sharded'
+        elif mode != 'dense':
+            raise ValueError(f'unknown dispatch {self.dispatch!r}; '
+                             "expected 'sparse', 'dense' or 'auto'")
+        compute = jnp.dtype(self.dtype)
+
+        if mode == 'sparse_sharded':
+            output, aux = self._sharded_sparse(flat, router, w1, b1, w2, b2,
+                                               compute)
+            return output.reshape(*batch_shape, dim).astype(hidden.dtype), aux
+
         logits = flat.astype(jnp.float32) @ router
         gates = jax.nn.softmax(logits)
         capacity = expert_capacity(tokens, self.experts, self.k,
                                    self.capacity_factor)
-
-        # 'sparse' is the O(tokens·k) sort/scatter path — the single-shard
-        # default. Neither the global argsort nor the slot scatter is
-        # partitionable along the token axis, so under ANY multi-device
-        # mesh (expert-, data- or tensor-sharded) 'auto' keeps the dense
-        # one-hot einsums, which GSPMD partitions freely (and whose EP
-        # all-to-all it inserts itself).
-        mode = self.dispatch
-        if mode == 'auto':
-            multi_device = self.mesh is not None and self.mesh.size > 1
-            mode = 'dense' if multi_device else 'sparse'
-        if mode not in ('sparse', 'dense'):
-            raise ValueError(f'unknown dispatch {self.dispatch!r}; '
-                             "expected 'sparse', 'dense' or 'auto'")
-        compute = jnp.dtype(self.dtype)
 
         if mode == 'sparse':
             token_ids, slots, weights, fraction = route_top_k_sparse(
@@ -192,26 +214,140 @@ class MoEMLP(nn.Module):
         aux = self.balance_coef * balance + self.z_coef * z_term
 
         expert_in = self._constrain(expert_in)
-        grown = jnp.einsum('ecd,edh->ech', expert_in, w1.astype(compute))
-        grown = nn.gelu(grown + b1[:, None].astype(compute))
-        shrunk = jnp.einsum('ech,ehd->ecd', grown, w2.astype(compute))
-        shrunk = shrunk + b2[:, None].astype(compute)
+        shrunk = self._ffn(expert_in, w1, b1, w2, b2, compute)
         shrunk = self._constrain(shrunk)
 
         if mode == 'sparse':
             buffer = shrunk.reshape(self.experts * capacity, dim)
-            gathered = buffer.at[slots].get(mode='fill', fill_value=0)
-            output = jnp.zeros((tokens, dim), compute).at[token_ids].add(
-                gathered * weights[:, None].astype(compute))
+            output = self._sparse_combine(buffer, slots, token_ids, weights,
+                                          tokens, dim, compute)
         else:
             output = jnp.einsum('nec,ecd->nd', combine.astype(compute), shrunk)
         return output.reshape(*batch_shape, dim).astype(hidden.dtype), aux
+
+    def _ffn(self, expert_in, w1, b1, w2, b2, compute):
+        """The per-expert MLP — one implementation for every dispatch path,
+        so the parity the tests pin cannot drift."""
+        grown = jnp.einsum('ecd,edh->ech', expert_in, w1.astype(compute))
+        grown = nn.gelu(grown + b1[:, None].astype(compute))
+        return (jnp.einsum('ech,ehd->ecd', grown, w2.astype(compute))
+                + b2[:, None].astype(compute))
+
+    @staticmethod
+    def _sparse_combine(buffer, slots, token_ids, weights, tokens, dim,
+                        compute):
+        gathered = buffer.at[slots].get(mode='fill', fill_value=0)
+        return jnp.zeros((tokens, dim), compute).at[token_ids].add(
+            gathered * weights[:, None].astype(compute))
 
     def _constrain(self, value):
         if self.mesh is None or self.mesh.shape[EXPERT] == 1:
             return value
         sharding = NamedSharding(self.mesh, P(EXPERT, None, None))
         return jax.lax.with_sharding_constraint(value, sharding)
+
+    def _sharded_sparse_blocker(self, tokens: int) -> str | None:
+        """Why the sharded sparse path cannot run (None = it can)."""
+        from tpusystem.parallel.mesh import DATA, FSDP, MODEL, SEQ
+        shape = dict(self.mesh.shape)
+        shards = (shape.get(DATA, 1) * shape.get(FSDP, 1)
+                  * shape.get(SEQ, 1) * shape.get(EXPERT, 1))
+        if shape.get(MODEL, 1) > 1:
+            return 'model-axis TP inside experts is dense-only'
+        if self.experts % shape.get(EXPERT, 1):
+            return (f'{self.experts} experts not divisible by the expert '
+                    f'axis ({shape.get(EXPERT, 1)})')
+        if tokens % shards:
+            return (f'{tokens} token rows not divisible by '
+                    f'data*fsdp*seq*expert = {shards}')
+        return None
+
+    def _sharded_sparse(self, flat, router, w1, b1, w2, b2, compute):
+        """Expert-parallel sparse dispatch inside ``shard_map``.
+
+        Token rows shard over (data, fsdp, expert); each device seats its
+        assignments into a ``[experts, quota]`` send buffer with
+        :func:`route_top_k_sparse` (quota = its share of the global
+        capacity), one **regular** ``all_to_all`` over the expert axis
+        hands every expert's rows to its owner, the FFN runs on
+        ``[local_experts, senders*quota]`` seated rows (no receiver-side
+        sort), and the inverse exchange brings outputs home for the
+        weighted combine. Fixed per-sender quotas are what make the
+        exchange static-shaped — the ragged-a2a formulation SURVEY §2.4
+        calls for, with padding instead of raggedness; ``all_to_all``
+        differentiates (its transpose is the reverse exchange), so the
+        whole path trains. Capacity semantics differ from the dense path:
+        drops are decided per sender (choice-major within each shard), not
+        by global token order — with ample capacity (no drops) the two
+        paths agree exactly.
+        """
+        import functools
+
+        from jax import lax
+
+        from tpusystem.parallel.mesh import DATA, FSDP, SEQ
+
+        mesh = self.mesh
+        expert_ax = mesh.shape[EXPERT]
+        local_experts = self.experts // expert_ax
+        shards = (mesh.shape[DATA] * mesh.shape[FSDP] * mesh.shape[SEQ]
+                  * expert_ax)
+        local_rows = flat.shape[0] // shards
+        # clamp like expert_capacity: a sender cannot route more than its
+        # local_rows assignments to any one expert, so a larger quota only
+        # pads the all_to_all buffers with unreachable zero rows
+        quota = max(1, min(local_rows,
+                           int(local_rows * self.k * self.capacity_factor
+                               / self.experts)))
+        dim = flat.shape[1]
+        experts, k = self.experts, self.k
+        row_axes = (DATA, FSDP, SEQ, EXPERT)
+        row_spec = P(row_axes, None)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=(row_spec, P(), P(EXPERT, None, None), P(EXPERT, None),
+                      P(EXPERT, None, None), P(EXPERT, None)),
+            out_specs=(row_spec, P()))
+        def run(rows, router, w1, b1, w2, b2):
+            logits = rows.astype(jnp.float32) @ router
+            gates = jax.nn.softmax(logits)
+            token_ids, slots, weights, fraction = route_top_k_sparse(
+                gates, k, quota)
+
+            send = jnp.zeros((experts * quota, dim), compute)
+            send = send.at[slots].set(rows.astype(compute)[token_ids],
+                                      mode='drop')
+            # chunk d of the send buffer (global expert order, owners
+            # contiguous) goes to device d; twice the same tiled exchange
+            # is the identity, which is how outputs come home below
+            recv = lax.all_to_all(send, EXPERT, split_axis=0, concat_axis=0,
+                                  tiled=True)
+            expert_in = (recv.reshape(expert_ax, local_experts, quota, dim)
+                         .transpose(1, 0, 2, 3)
+                         .reshape(local_experts, expert_ax * quota, dim))
+
+            shrunk = self._ffn(expert_in, w1, b1, w2, b2, compute)
+
+            back = (shrunk.reshape(local_experts, expert_ax, quota, dim)
+                    .transpose(1, 0, 2, 3)
+                    .reshape(experts * quota, dim))
+            buffer = lax.all_to_all(back, EXPERT, split_axis=0, concat_axis=0,
+                                    tiled=True)
+            output = self._sparse_combine(buffer, slots, token_ids,
+                                          weights, rows.shape[0], dim,
+                                          compute)
+
+            # Switch balance/z losses over GLOBAL token statistics
+            fraction = lax.pmean(fraction, row_axes)
+            mean_gates = lax.pmean(jnp.mean(gates, axis=0), row_axes)
+            balance = experts * jnp.sum(fraction * mean_gates)
+            z_term = lax.pmean(
+                jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2), row_axes)
+            aux = self.balance_coef * balance + self.z_coef * z_term
+            return output, aux
+
+        return run(flat, router, w1, b1, w2, b2)
 
 
 def moe_partition_rules():

@@ -360,7 +360,8 @@ def flash_attention(query, key, value, *, causal: bool = True,
     """Flash attention over [batch, length, heads, head_dim] tensors.
 
     Drop-in for :func:`tpusystem.ops.attention.dot_product_attention`
-    (GQA supported via KV-head broadcast) in single-device-per-shard
+    (GQA handled in-kernel: grouped KV is shared across each query-head
+    group via the block index maps, never broadcast) in single-device-per-shard
     contexts — see the module docstring for the GSPMD caveat. Falls back to
     the XLA path when the sequence length does not divide the block sizes.
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same
