@@ -82,7 +82,7 @@ with Runtime(coordinator=coordinator, num_processes=nprocs, process_id=rank,
     mesh = MeshSpec(data=-1).build()
     module = gpt2_tiny(attention='xla', dtype='float32')
     optimizer = SGD(lr=0.1)
-    tokens = np.random.default_rng(0).integers(0, 256, (8, 32)).astype(np.int32)
+    tokens = np.random.default_rng(0).integers(0, 256, (12, 32)).astype(np.int32)
     state = init_state(module, optimizer, jnp.asarray(tokens[:1]))
     # become global arrays: params replicated, batch sharded over data —
     # each process contributes its local rows of the global batch
@@ -113,8 +113,8 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_runtime_end_to_end(tmp_path):
-    nprocs = 2
+@pytest.mark.parametrize('nprocs', [2, 3])
+def test_multi_process_runtime_end_to_end(tmp_path, nprocs):
     coordinator = f'localhost:{_free_port()}'
     worker = tmp_path / 'worker.py'
     worker.write_text(WORKER)
@@ -140,7 +140,7 @@ def test_two_process_runtime_end_to_end(tmp_path):
                for rank in range(nprocs)}
     for rank, record in records.items():
         assert record['process_count'] == nprocs
-        assert record['global_devices'] == 4      # 2 procs x 2 virtual chips
+        assert record['global_devices'] == 2 * nprocs   # 2 virtual chips each
         assert record['local_devices'] == 2
         assert record['is_primary'] == (rank == 0)
         assert record['agree_none'] is False      # nobody wants to stop

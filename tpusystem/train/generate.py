@@ -76,22 +76,27 @@ def generate(module, params, prompt, *, steps: int,
 
 
 def speculative_generate(module, params, prompt, *, steps: int,
-                         draft_module, draft_params, speculate: int = 4):
-    """Greedy generation accelerated by a draft model (speculative decoding).
+                         draft_module, draft_params, speculate: int = 4,
+                         temperature: float = 0.0, rng=None):
+    """Generation accelerated by a draft model (speculative decoding).
 
     The draft proposes ``speculate`` tokens autoregressively (cheap model,
     cheap steps); the target verifies them in ONE forward over the
-    proposed window and accepts the longest prefix that matches its own
-    greedy choices, emitting one extra corrected token — so each target
-    forward yields between 1 and ``speculate + 1`` tokens. **Output is
-    exactly the target's greedy decode regardless of draft quality** (a
-    bad draft only costs speed); both KV caches rewind their cursors to
-    the accepted prefix each round.
+    proposed window, emitting the accepted prefix plus one corrected
+    token — so each target forward yields between 1 and ``speculate + 1``
+    tokens, and a bad draft only costs speed, never correctness:
 
+    * ``temperature=0``: acceptance is exact match against the target's
+      greedy choices — **output is exactly the target's greedy decode**.
+    * ``temperature>0``: rejection-sampling acceptance (Leviathan et al.):
+      draft token ``d`` is accepted with probability ``min(1, p(d)/q(d))``
+      and a rejection resamples from ``norm(max(0, p - q))`` — the output
+      **distribution** is exactly the target's sampling distribution.
+
+    Both KV caches rewind their cursors to the accepted prefix each round.
     Batched prompts advance by the *minimum* acceptance across the batch
     (per-element cursors would need per-row cache writes), so speedup is
-    largest at small batch. Greedy only — temperature sampling needs
-    rejection-sampling acceptance, not shipped yet.
+    largest at small batch.
 
     Returns int32 ``[batch, prompt_len + steps]`` like :func:`generate`.
     """
@@ -99,6 +104,9 @@ def speculative_generate(module, params, prompt, *, steps: int,
         raise ValueError(f'steps must be >= 1, got {steps}')
     if speculate < 1:
         raise ValueError(f'speculate must be >= 1, got {speculate}')
+    if temperature > 0.0 and rng is None:
+        raise ValueError('temperature sampling needs an rng key')
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
     decoder, drafter = _decoder(module), _decoder(draft_module)
     needed = prompt.shape[1] + steps + speculate + 1
     capacity = min(decoder.max_seq, drafter.max_seq)
@@ -108,10 +116,12 @@ def speculative_generate(module, params, prompt, *, steps: int,
             f'capacity max_seq={capacity} (verification overshoots by up to '
             f'speculate tokens before rewinding)')
     try:
-        run = _compiled_speculative(decoder, drafter, steps, speculate)
+        run = _compiled_speculative(decoder, drafter, steps, speculate,
+                                    temperature)
     except TypeError:       # unhashable module field
-        run = _build_speculative(decoder, drafter, steps, speculate)
-    return run(params, draft_params, prompt)
+        run = _build_speculative(decoder, drafter, steps, speculate,
+                                 temperature)
+    return run(params, draft_params, prompt, rng)
 
 
 def _rewind(cache, cursor):
@@ -131,21 +141,24 @@ def _rewind(cache, cursor):
 
 
 @functools.cache
-def _compiled_speculative(decoder, drafter, steps: int, speculate: int):
-    return _build_speculative(decoder, drafter, steps, speculate)
+def _compiled_speculative(decoder, drafter, steps: int, speculate: int,
+                          temperature: float):
+    return _build_speculative(decoder, drafter, steps, speculate, temperature)
 
 
-def _build_speculative(decoder, drafter, steps: int, speculate: int):
+def _build_speculative(decoder, drafter, steps: int, speculate: int,
+                       temperature: float):
     K = speculate
 
     @jax.jit
-    def run(params, draft_params, prompt):
+    def run(params, draft_params, prompt, rng):
         batch, prefix = prompt.shape
         tlogits, tstate = decoder.apply({'params': params}, prompt,
                                         mutable=['cache'])
         _, dstate = drafter.apply({'params': draft_params}, prompt,
                                   mutable=['cache'])
-        token = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+        rng, key = jax.random.split(rng)
+        token = _sample(tlogits[:, -1], temperature, key)
         # padded so a full window write at the last offset stays in bounds
         out = jnp.zeros((batch, steps + K + 1), jnp.int32)
         out = out.at[:, 0].set(token)
@@ -154,37 +167,80 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int):
             return carry[0] < steps
 
         def body(carry):
-            produced, cursor, token, out, tcache, dcache = carry
+            produced, cursor, token, out, rng, tcache, dcache = carry
+            rng, draft_rng, accept_rng, fix_rng = jax.random.split(rng, 4)
 
-            def draft_step(state, _):
+            def draft_step(state, key):
                 cache, tok = state
                 logits, updated = drafter.apply(
                     {'params': draft_params, 'cache': cache}, tok[:, None],
                     mutable=['cache'])
-                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-                return (updated['cache'], nxt), nxt
+                logits = logits[:, -1]
+                nxt = _sample(logits, temperature, key)
+                return (updated['cache'], nxt), (nxt, logits)
 
             # K+1 steps: the last consumes d_K so the draft cache holds its
             # KV when every draft is accepted (the extra proposal is unused)
-            (dcache, _), drafts = jax.lax.scan(
-                draft_step, (dcache, token), None, length=K + 1)
-            drafts = jnp.moveaxis(drafts, 0, 1)[:, :K]   # [B, K]
+            (dcache, _), (drafts, draft_logits) = jax.lax.scan(
+                draft_step, (dcache, token),
+                jax.random.split(draft_rng, K + 1))
+            drafts = jnp.moveaxis(drafts, 0, 1)[:, :K]            # [B, K]
+            draft_logits = jnp.moveaxis(draft_logits, 0, 1)[:, :K]
 
             # one target forward over the whole proposed window
             window = jnp.concatenate([token[:, None], drafts], axis=1)
             vlogits, tupdated = decoder.apply(
                 {'params': params, 'cache': tcache}, window,
                 mutable=['cache'])
-            candidates = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
 
-            # accept the longest draft prefix matching the target's greedy
-            # choices; the whole batch advances by the minimum acceptance
-            matches = (drafts == candidates[:, :K]).astype(jnp.int32)
-            accepted = jnp.min(jnp.sum(jnp.cumprod(matches, axis=1), axis=1))
+            if temperature == 0.0:
+                # acceptance = exact match against the target's greedy
+                # choices; correction = the target's own choice there
+                candidates = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+                matches = (drafts == candidates[:, :K]).astype(jnp.int32)
+                accepted = jnp.min(
+                    jnp.sum(jnp.cumprod(matches, axis=1), axis=1))
+                correction = jax.lax.dynamic_index_in_dim(
+                    candidates, accepted, axis=1, keepdims=False)
+            else:
+                # rejection sampling: accept draft token d with probability
+                # min(1, p(d)/q(d)); the correction resamples from
+                # norm(max(0, p - q)) at the first rejection, or from p
+                # itself when every draft was accepted (q masked to 0)
+                p_dist = jax.nn.softmax(
+                    vlogits.astype(jnp.float32) / temperature, axis=-1)
+                q_dist = jax.nn.softmax(
+                    draft_logits.astype(jnp.float32) / temperature, axis=-1)
+                p_draft = jnp.take_along_axis(
+                    p_dist[:, :K], drafts[..., None], axis=-1)[..., 0]
+                q_draft = jnp.take_along_axis(
+                    q_dist, drafts[..., None], axis=-1)[..., 0]
+                uniforms = jax.random.uniform(accept_rng, (batch, K))
+                accepts = (uniforms * q_draft < p_draft).astype(jnp.int32)
+                per_row = jnp.sum(jnp.cumprod(accepts, axis=1), axis=1)
+                accepted = jnp.min(per_row)                       # batch min
+                p_at = jax.lax.dynamic_index_in_dim(
+                    p_dist, accepted, axis=1, keepdims=False)     # [B, V]
+                q_padded = jnp.pad(q_dist, ((0, 0), (0, 1), (0, 0)))
+                q_at = jax.lax.dynamic_index_in_dim(
+                    q_padded, accepted, axis=1, keepdims=False)
+                residual = jnp.maximum(p_at - q_at, 0.0)
+                # float rounding can zero the residual; fall back to p
+                degenerate = jnp.sum(residual, -1, keepdims=True) < 1e-9
+                residual = jnp.where(degenerate, p_at, residual)
+                resampled = jax.random.categorical(
+                    fix_rng, jnp.log(residual + 1e-20), axis=-1
+                ).astype(jnp.int32)
+                # rows that accepted MORE than the batch minimum keep their
+                # accepted draft at this position instead of resampling
+                row_accepted_here = (per_row > accepted) & (accepted < K)
+                padded_drafts = jnp.pad(drafts, ((0, 0), (0, 1)))
+                draft_here = jax.lax.dynamic_index_in_dim(
+                    padded_drafts, accepted, axis=1, keepdims=False)
+                correction = jnp.where(row_accepted_here, draft_here,
+                                       resampled)
 
-            # emit accepted drafts plus the target's correction token
-            correction = jax.lax.dynamic_index_in_dim(
-                candidates, accepted, axis=1, keepdims=False)
+            # emit accepted drafts plus the per-row correction token
             positions = jnp.arange(K + 1)[None, :]
             emitted = jnp.where(
                 positions < accepted,
@@ -196,14 +252,13 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int):
             cursor = cursor + accepted + 1
             token = jax.lax.dynamic_index_in_dim(
                 emitted, accepted, axis=1, keepdims=False)
-            return (produced, cursor,
-                    token, out,
+            return (produced, cursor, token, out, rng,
                     _rewind(tupdated['cache'], cursor),
                     _rewind(dcache, cursor))
 
-        carry = (jnp.int32(1), jnp.int32(prefix), token, out,
+        carry = (jnp.int32(1), jnp.int32(prefix), token, out, rng,
                  tstate['cache'], dstate['cache'])
-        _, _, _, out, _, _ = jax.lax.while_loop(cond, body, carry)
+        _, _, _, out, _, _, _ = jax.lax.while_loop(cond, body, carry)
         return jnp.concatenate([prompt, out[:, :steps]], axis=1)
 
     return run
