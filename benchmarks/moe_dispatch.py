@@ -52,3 +52,46 @@ for experts in (8, 32, 64):
 print('--- 16k/32k tokens, 64 experts, sparse only ---')
 bench('sparse', 64, tokens=16384)
 bench('sparse', 64, tokens=32768)
+
+
+def exchanged_bytes(experts=64, devices=8, tokens=65536, dim=4096, k=2,
+                    capacity_factor=1.25, skew=0.0, seed=0):
+    """ICI bytes per MoE layer for the quota'd all_to_all vs the ragged
+    exchange, from actual router statistics (the quota path ships its full
+    static buffer regardless of routing; ragged ships the routed rows).
+
+    ``skew`` > 0 biases the router toward a subset of experts, the regime
+    where the quota path both pads *and* drops.
+    """
+    rng = np.random.default_rng(seed)
+    local_rows = tokens // devices
+    logits = rng.normal(size=(tokens, experts)).astype(np.float32)
+    if skew:
+        logits[:, : experts // 4] += skew
+    top = np.argsort(-logits, axis=1)[:, :k]
+    bytes_per_row = dim * 2                      # bf16 activations
+    # quota path: every sender ships experts*quota rows, twice (there+back)
+    quota = max(1, min(local_rows, int(local_rows * k * capacity_factor
+                                       / experts)))
+    quota_bytes = devices * experts * quota * bytes_per_row * 2
+    # ragged path: each sender ships its actual kept assignments, capped at
+    # min(local_rows, group capacity) per expert
+    group_capacity = max(1, min(tokens, int(tokens * k * capacity_factor
+                                            / experts)))
+    send_cap = min(local_rows, group_capacity)
+    ragged_rows = 0
+    for d in range(devices):
+        mine = top[d * local_rows:(d + 1) * local_rows].reshape(-1)
+        counts = np.bincount(mine, minlength=experts)
+        ragged_rows += np.minimum(counts, send_cap).sum()
+    ragged_bytes = int(ragged_rows) * bytes_per_row * 2
+    print(json.dumps({
+        "experts": experts, "devices": devices, "tokens": tokens,
+        "skew": skew, "quota_MB": round(quota_bytes / 2**20, 1),
+        "ragged_MB": round(ragged_bytes / 2**20, 1),
+        "ragged_over_quota": round(ragged_bytes / quota_bytes, 3)}))
+
+
+print('--- exchanged bytes per layer, quota vs ragged a2a ---')
+exchanged_bytes(skew=0.0)
+exchanged_bytes(skew=1.5)

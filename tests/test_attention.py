@@ -289,6 +289,55 @@ def test_flash_lse_matches_reference_and_grads(qkv):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=5e-5)
 
 
+def test_zigzag_halves_ring_flops(qkv, monkeypatch):
+    """Per-device attention block area: the contiguous causal ring computes
+    n full chunk-pair attentions (discarding the invisible ones); zigzag
+    computes (2n+1) stripe blocks = ~half the area at n=4 and falling
+    toward exactly half as n grows."""
+    import tpusystem.ops.ring as ring_module
+    q, k, v = qkv
+    mesh = MeshSpec(seq=4).build(jax.devices()[:4])
+
+    area = []
+    real = ring_module._attention_lse
+
+    def counting(query, key, value, **kwargs):
+        area.append(query.shape[1] * key.shape[1])
+        return real(query, key, value, **kwargs)
+
+    monkeypatch.setattr(ring_module, '_attention_lse', counting)
+
+    def measure(variant):
+        area.clear()
+        jax.eval_shape(lambda: ring_module.ring_self_attention(
+            q, k, v, mesh, causal=True, variant=variant))
+        return sum(area)     # shard_map traces once: per-device area
+
+    ring = 4
+    chunk = q.shape[1] // ring
+    stripe = chunk // 2
+    zigzag = measure('zigzag')
+    # contiguous ring: n chunk-pair attentions per device, all computed
+    # (invisible ones discarded post-hoc) = n * chunk^2 block area
+    naive = ring * chunk * chunk
+    assert zigzag == (2 * ring + 1) * stripe * stripe, zigzag
+    assert zigzag <= 0.6 * naive, (zigzag, naive)
+
+
+def test_ring_variant_auto_upgrades_to_zigzag(qkv, monkeypatch):
+    """variant='ring' + causal + stripeable length routes through zigzag."""
+    import tpusystem.ops.ring as ring_module
+    q, k, v = qkv
+    mesh = MeshSpec(seq=4).build(jax.devices()[:4])
+    used = []
+    real = ring_module.zigzag_ring_attention
+    monkeypatch.setattr(ring_module, 'zigzag_ring_attention',
+                        lambda *a, **kw: used.append(1) or real(*a, **kw))
+    jax.eval_shape(lambda: ring_module.ring_self_attention(
+        q, k, v, mesh, causal=True, variant='ring'))
+    assert used
+
+
 @pytest.mark.slow
 def test_ring_einsum_inner_fallback_matches(qkv):
     """inner='einsum' (the XLA fallback path) stays at parity too."""

@@ -118,6 +118,39 @@ def test_decode_clone_strips_training_settings(prompt):
 
 
 @pytest.mark.slow
+def test_long_prompt_prefill_uses_flash_and_matches_xla(monkeypatch):
+    """Prompts >= 512 tokens prefill through the flash kernel (O(seq)
+    memory) instead of building the O(seq^2) einsum scores tensor — and
+    the prefill logits are unchanged."""
+    from tpusystem.ops.pallas import flash as flash_module
+
+    module = gpt2_tiny(dtype='float32', max_seq=1024)
+    long_prompt = jnp.asarray(
+        np.random.default_rng(4).integers(0, 256, (1, 512)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), long_prompt)['params']
+
+    calls = []
+    real_flash = flash_module.flash_attention
+
+    def counting_flash(*args, **kwargs):
+        calls.append(args[0].shape)
+        return real_flash(*args, **kwargs)
+
+    import tpusystem.ops.pallas.flash
+    monkeypatch.setattr(tpusystem.ops.pallas.flash, 'flash_attention',
+                        counting_flash)
+
+    import dataclasses
+    decoder = dataclasses.replace(module, decode=True)
+    logits, _ = decoder.apply({'params': params}, long_prompt,
+                              mutable=['cache'])
+    assert len(calls) == module.layers, calls      # every layer's prefill
+    reference = module.apply({'params': params}, long_prompt)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(reference),
+                               atol=2e-4)
+
+
+@pytest.mark.slow
 def test_speculative_decode_equals_greedy_regardless_of_draft():
     """The speculative output must be EXACTLY the target's greedy decode —
     the draft only affects speed. Pinned with a random-weight draft (worst

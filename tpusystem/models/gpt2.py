@@ -90,6 +90,7 @@ class Block(nn.Module):
     moe_experts: int = 0
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_exchange: str = 'quota'
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -110,6 +111,7 @@ class Block(nn.Module):
                                  mlp_ratio=self.mlp_ratio,
                                  capacity_factor=self.moe_capacity_factor,
                                  dtype=self.dtype, mesh=self.mesh,
+                                 exchange=self.moe_exchange,
                                  name='moe')(normed.astype(self.dtype))
         else:
             grown = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name='fc')(
@@ -140,6 +142,10 @@ class GPT2(nn.Module):
     mesh: object = None  # mesh for ring/ulysses sequence parallelism
     attn_dropout: float | None = None  # None -> follow `dropout` ('xla' only)
     remat: bool = False  # recompute each block's activations in backward
+    scan_layers: bool = False  # one lax.scan over stacked block params
+    # instead of `layers` unrolled copies: XLA compiles ONE block body, so
+    # compile time stops scaling with depth (the 32-layer 8B unroll is the
+    # compile-time cliff); params live under 'hs' with a leading layer dim
     return_features: bool = False  # return (features, wte table) for a fused
     # chunked LM loss (train.ChunkedNextTokenLoss) instead of full logits
     decode: bool = False  # KV-cache autoregressive decoding (see
@@ -148,6 +154,8 @@ class GPT2(nn.Module):
     moe_every: int = 2
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
+    moe_exchange: str = 'quota'  # multi-device exchange: 'quota' | 'ragged'
+    # | 'ragged-emulated' (see tpusystem.ops.moe.MoEMLP)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -172,23 +180,46 @@ class GPT2(nn.Module):
             f'sequence length {tokens.shape[-1]} exceeds max_seq={self.max_seq}')
         block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
         aux_losses = []
-        for index in range(self.layers):
-            is_moe = (self.moe_experts > 0
-                      and index % self.moe_every == self.moe_every - 1)
-            block = block_cls(self.heads, self.mlp_ratio, self.dropout,
-                              compute_dtype, attention=self.attention,
-                              mesh=self.mesh, attn_dropout=self.attn_dropout,
-                              decode=self.decode, max_seq=self.max_seq,
-                              moe_experts=self.moe_experts if is_moe else 0,
-                              moe_k=self.moe_k,
-                              moe_capacity_factor=self.moe_capacity_factor,
-                              name=f'h_{index}')
-            result = block(hidden, train)
-            if is_moe:
-                hidden, aux = result
-                aux_losses.append(aux)
-            else:
-                hidden = result
+        if self.scan_layers:
+            # one compiled block body, stacked params, lax.scan over depth —
+            # compile time is O(1) in layer count instead of O(layers).
+            # Heterogeneous stacks (MoE every k-th block) and per-layer
+            # cache variables (decode) stay on the unrolled path.
+            if self.moe_experts or self.decode:
+                raise ValueError('scan_layers supports homogeneous '
+                                 'non-decode stacks (no moe_experts, no '
+                                 'decode)')
+            template = block_cls(self.heads, self.mlp_ratio, self.dropout,
+                                 compute_dtype, attention=self.attention,
+                                 mesh=self.mesh,
+                                 attn_dropout=self.attn_dropout,
+                                 max_seq=self.max_seq, name='hs')
+            scan = nn.scan(
+                lambda block, carry, _: (block(carry, train), None),
+                variable_axes={'params': 0},
+                split_rngs={'params': True, 'dropout': True},
+                length=self.layers)
+            hidden, _ = scan(template, hidden, None)
+        else:
+            for index in range(self.layers):
+                is_moe = (self.moe_experts > 0
+                          and index % self.moe_every == self.moe_every - 1)
+                block = block_cls(self.heads, self.mlp_ratio, self.dropout,
+                                  compute_dtype, attention=self.attention,
+                                  mesh=self.mesh,
+                                  attn_dropout=self.attn_dropout,
+                                  decode=self.decode, max_seq=self.max_seq,
+                                  moe_experts=self.moe_experts if is_moe else 0,
+                                  moe_k=self.moe_k,
+                                  moe_capacity_factor=self.moe_capacity_factor,
+                                  moe_exchange=self.moe_exchange,
+                                  name=f'h_{index}')
+                result = block(hidden, train)
+                if is_moe:
+                    hidden, aux = result
+                    aux_losses.append(aux)
+                else:
+                    hidden = result
         hidden = nn.LayerNorm(dtype=jnp.float32, name='ln_f')(hidden)
         # tied LM head: logits against the token embedding table. The matmul
         # runs bf16 x bf16 (MXU rate) accumulating into f32 — f32 operands
@@ -228,9 +259,15 @@ class GPT2(nn.Module):
 
         qkv/fc split columns on ``model``; out/proj split rows (their
         all-reduce rides ICI); embeddings split the vocab/position table.
+        The ``hs/`` rules cover the ``scan_layers`` stacked variant (same
+        splits shifted one dim right past the leading layer axis).
         """
         from tpusystem.ops.moe import moe_partition_rules
         return (
+            (r'hs/attn/qkv/kernel$', P(None, None, 'model')),
+            (r'hs/attn/out/kernel$', P(None, 'model', None)),
+            (r'hs/fc/kernel$', P(None, None, 'model')),
+            (r'hs/proj/kernel$', P(None, 'model', None)),
             (r'attn/qkv/kernel$', P(None, 'model')),
             (r'attn/out/kernel$', P('model', None)),
             (r'fc/kernel$', P(None, 'model')),
@@ -263,14 +300,23 @@ class GPT2Pipelined:
                  dim: int = 768, heads: int = 12, max_seq: int = 1024,
                  mlp_ratio: int = 4, dtype: str = 'bfloat16',
                  microbatches: int = 4, remat: bool = True, mesh=None,
-                 return_features: bool = False):
+                 return_features: bool = False, interleave: int = 1):
         if mesh is None:
             raise ValueError('GPT2Pipelined needs a mesh with a stage axis')
+        if layers % max(interleave, 1):
+            raise ValueError(f'{layers} layers not divisible by '
+                             f'interleave={interleave}')
         self.vocab_size, self.layers, self.dim = vocab_size, layers, dim
         self.heads, self.max_seq, self.mlp_ratio = heads, max_seq, mlp_ratio
         self.dtype = dtype
         self.microbatches, self.remat, self.mesh = microbatches, remat, mesh
         self.return_features = return_features
+        # interleave > 1: the stacked params are stored chunk-major
+        # ([interleave, layers/interleave, ...], a plain reshape of the
+        # layer-major stack) so the interleaved 1F1B schedule's
+        # P(None, stage) sharding places each device's v non-contiguous
+        # chunks without per-step resharding
+        self.interleave = interleave
         self.block = Block(heads, mlp_ratio, 0.0, jnp.dtype(dtype))
         self.stacked_key = 'h'   # params key of the stage-sharded layer stack
 
@@ -282,6 +328,12 @@ class GPT2Pipelined:
         sample = jnp.zeros((1, 8, self.dim), jnp.dtype(self.dtype))
         stacked = jax.vmap(lambda key: self.block.init(key, sample)['params'])(
             keys[:self.layers])
+        if self.interleave > 1:
+            stacked = jax.tree.map(
+                lambda leaf: leaf.reshape(
+                    (self.interleave, self.layers // self.interleave)
+                    + leaf.shape[1:]),
+                stacked)
         scale = 0.02
         wte = scale * jax.random.normal(keys[-2], (self.vocab_size, self.dim))
         wpe = scale * jax.random.normal(keys[-1], (self.max_seq, self.dim))
@@ -315,11 +367,21 @@ class GPT2Pipelined:
             return self.block.apply({'params': layer_params}, activations)
         return block_fn
 
+    def _flat_stack(self, stacked):
+        """Layer-major view of the stacked block params (undoes the
+        chunk-major interleave storage; identity when interleave == 1)."""
+        if self.interleave <= 1:
+            return stacked
+        return jax.tree.map(
+            lambda leaf: leaf.reshape((self.layers,) + leaf.shape[2:]),
+            stacked)
+
     def apply(self, variables, tokens, rngs=None, train: bool = False):
         from tpusystem.parallel.pipeline import pipeline_apply
         params = variables['params']
         hidden = self._embed(params, tokens)
-        hidden = pipeline_apply(self._block_fn(), params['h'], hidden, self.mesh,
+        hidden = pipeline_apply(self._block_fn(), self._flat_stack(params['h']),
+                                hidden, self.mesh,
                                 microbatches=self.microbatches, remat=self.remat)
         return self._head(params, hidden)
 
@@ -332,13 +394,17 @@ class GPT2Pipelined:
         def layer(carry, layer_params):
             return block_fn(layer_params, carry), None
 
-        hidden, _ = jax.lax.scan(layer, hidden, params['h'])
+        hidden, _ = jax.lax.scan(layer, hidden, self._flat_stack(params['h']))
         return self._head(params, hidden)
 
-    @staticmethod
-    def partition_rules():
+    def partition_rules(self):
         """Stage sharding for the stacked blocks; embeddings/ln replicated
-        (combine with ``fsdp=True`` on the policy to scatter them)."""
+        (combine with ``fsdp=True`` on the policy to scatter them). With
+        interleave, the chunk-major stack shards its *second* dim (the
+        within-chunk layer index groups ``stages`` contiguous layers per
+        device — see ``pipeline_train``'s layout contract)."""
+        if self.interleave > 1:
+            return ((r'(^|/)h/', P(None, 'stage')),)
         return ((r'(^|/)h/', P('stage')),)
 
 

@@ -184,6 +184,10 @@ class Llama(nn.Module):
     attention: str = 'xla'
     mesh: object = None
     remat: bool = False
+    scan_layers: bool = False  # one lax.scan over stacked block params
+    # instead of 32 unrolled copies: XLA compiles ONE block body, so 8B
+    # compile time stops scaling with depth; params live under 'blocks'
+    # with a leading layer dim (see partition_rules)
     return_features: bool = False  # return (features, head kernel) for a
     # fused chunked LM loss (train.ChunkedNextTokenLoss); at 128k vocab the
     # full f32 logits tensor is the dominant memory term
@@ -200,12 +204,29 @@ class Llama(nn.Module):
         hidden = hidden.astype(compute_dtype)
         block_cls = (nn.remat(LlamaBlock, static_argnums=(2,))
                      if self.remat else LlamaBlock)
-        for index in range(self.layers):
-            hidden = block_cls(self.heads, self.kv_heads, self.ffn_dim,
-                               compute_dtype, self.rope_theta,
-                               attention=self.attention, mesh=self.mesh,
-                               decode=self.decode, max_seq=self.max_seq,
-                               name=f'layer_{index}')(hidden, train)
+        if self.scan_layers:
+            # one compiled block body + stacked params: compile time is
+            # O(1) in depth. Decode stays unrolled (per-layer cache vars).
+            if self.decode:
+                raise ValueError('scan_layers does not support decode '
+                                 '(per-layer KV-cache variables)')
+            template = block_cls(self.heads, self.kv_heads, self.ffn_dim,
+                                 compute_dtype, self.rope_theta,
+                                 attention=self.attention, mesh=self.mesh,
+                                 max_seq=self.max_seq, name='blocks')
+            scan = nn.scan(
+                lambda block, carry, _: (block(carry, train), None),
+                variable_axes={'params': 0},
+                split_rngs={'params': True},
+                length=self.layers)
+            hidden, _ = scan(template, hidden, None)
+        else:
+            for index in range(self.layers):
+                hidden = block_cls(self.heads, self.kv_heads, self.ffn_dim,
+                                   compute_dtype, self.rope_theta,
+                                   attention=self.attention, mesh=self.mesh,
+                                   decode=self.decode, max_seq=self.max_seq,
+                                   name=f'layer_{index}')(hidden, train)
         hidden = RMSNorm(name='final_norm')(hidden)
         # untied head (Llama-3 convention). bf16 x bf16 operands at MXU
         # rate, f32 accumulation out for a stable softmax/loss. The kernel
@@ -221,8 +242,14 @@ class Llama(nn.Module):
     def partition_rules():
         """Megatron-style TP rules: q/k/v/gate/up split columns on ``model``;
         out/down split rows (their all-reduce rides ICI); embedding and head
-        split the vocab dimension."""
+        split the vocab dimension. The ``blocks/`` rules cover the
+        ``scan_layers`` stacked variant (same splits shifted one dim right
+        past the leading layer axis)."""
         return (
+            (r'blocks/attn/(q|k|v)/kernel$', P(None, None, 'model')),
+            (r'blocks/attn/out/kernel$', P(None, 'model', None)),
+            (r'blocks/(gate|up)/kernel$', P(None, None, 'model')),
+            (r'blocks/down/kernel$', P(None, 'model', None)),
             (r'attn/(q|k|v)/kernel$', P(None, 'model')),
             (r'attn/out/kernel$', P('model', None)),
             (r'(gate|up)/kernel$', P(None, 'model')),

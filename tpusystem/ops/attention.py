@@ -124,6 +124,15 @@ def cached_attention(module, query, key, value, max_seq: int):
         (0, cursor, 0, 0))
     index.value = cursor + length
     if prefill:
+        # Long prompts route through the flash kernel: einsum attention
+        # materializes the [B, H, L, L] scores tensor — at Llama's
+        # max_seq=8192 that is exactly the allocation flash exists to
+        # avoid, paid once per generation. flash_attention falls back to
+        # the einsum path itself when the length cannot tile, so short
+        # prompts lose nothing.
+        if length >= 512:
+            from tpusystem.ops.pallas.flash import flash_attention
+            return flash_attention(query, key, value, causal=True)
         return dot_product_attention(query, key, value, causal=True)
     # attend causally over the filled prefix: key position <= cursor + offset
     mask = (jnp.arange(max_seq)[None, :]

@@ -31,12 +31,100 @@ folds the aux term into any base criterion.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
+from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from tpusystem.parallel.mesh import EXPERT
+
+
+def _ragged_transport(transport: str, axis: str, operand, out_init,
+                      in_off, send_sz, out_off, recv_sz):
+    """One ragged exchange over ``axis``: chunk ``d`` of ``operand``
+    (``[in_off[d], in_off[d] + send_sz[d])``) lands on device ``d`` at
+    offset ``out_off[d]`` of its ``out_init``-shaped buffer.
+
+    ``transport='ragged'`` is ``jax.lax.ragged_all_to_all`` — bytes on the
+    wire are the *actual* routed rows. ``'gathered'`` is a semantically
+    identical emulation (all_gather + masked slice) for backends whose XLA
+    has no ragged-all-to-all lowering (CPU, incl. the virtual test meshes);
+    it moves more bytes but seats identically, so tests pin the semantics
+    the TPU transport then inherits.
+    """
+    if transport == 'ragged':
+        return lax.ragged_all_to_all(operand, out_init, in_off, send_sz,
+                                     out_off, recv_sz, axis_name=axis)
+    if transport != 'gathered':
+        raise ValueError(f'unknown ragged transport {transport!r}')
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    all_ops = lax.all_gather(operand, axis)              # [n, S, cols]
+    all_in_off = lax.all_gather(in_off, axis)            # [n, n]
+    all_send = lax.all_gather(send_sz, axis)
+    all_out_off = lax.all_gather(out_off, axis)
+    out = out_init
+    rows = jnp.arange(out_init.shape[0])
+    for sender in range(n):
+        src_off = all_in_off[sender, me]
+        size = all_send[sender, me]
+        dst_off = all_out_off[sender, me]
+        take = jnp.clip(rows - dst_off + src_off, 0, operand.shape[0] - 1)
+        values = jnp.take(all_ops[sender], take, axis=0)
+        mask = (rows >= dst_off) & (rows < dst_off + size)
+        out = jnp.where(mask[:, None], values, out)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ragged_exchange(transport, axis, operand, out_init, in_off, send_sz,
+                     out_off, recv_off, recv_sz, rev_out_off):
+    """Differentiable ragged exchange.
+
+    ``ragged_all_to_all`` has no transpose rule in XLA, so the backward is
+    supplied explicitly (ROADMAP: custom_vjp for the reverse exchange): the
+    cotangent of the output is exchanged *back* with the send/recv roles
+    swapped — my received chunks (``recv_off``/``recv_sz``) return to their
+    senders, landing at the positions they were sent from
+    (``rev_out_off[d]`` = the offset device ``d`` used for me, i.e. its
+    ``in_off[me]``).
+    """
+    return _ragged_transport(transport, axis, operand, out_init,
+                             in_off, send_sz, out_off, recv_sz)
+
+
+def _ragged_exchange_fwd(transport, axis, operand, out_init, in_off, send_sz,
+                         out_off, recv_off, recv_sz, rev_out_off):
+    out = _ragged_transport(transport, axis, operand, out_init,
+                            in_off, send_sz, out_off, recv_sz)
+    residuals = (in_off, send_sz, recv_off, recv_sz, rev_out_off,
+                 operand.shape, out_init.shape)
+    return out, residuals
+
+
+def _ragged_exchange_bwd(transport, axis, residuals, cot):
+    in_off, send_sz, recv_off, recv_sz, rev_out_off, op_shape, out_shape = residuals
+    # reverse roles: my received chunks carry the cotangent home
+    d_operand = _ragged_transport(
+        transport, axis, cot, jnp.zeros(op_shape, cot.dtype),
+        recv_off, recv_sz, rev_out_off, send_sz)
+    # out_init passes through wherever nothing was received
+    rows = jnp.arange(out_shape[0])
+    received = jnp.zeros((out_shape[0],), bool)
+    for sender in range(recv_off.shape[0]):
+        received = received | ((rows >= recv_off[sender])
+                               & (rows < recv_off[sender] + recv_sz[sender]))
+    d_init = jnp.where(received[:, None], 0, cot)
+    f0 = lambda arr: np.zeros(arr.shape, jax.dtypes.float0)
+    return (d_operand, d_init, f0(in_off), f0(send_sz), f0(send_sz),
+            f0(recv_off), f0(recv_sz), f0(rev_out_off))
+
+
+_ragged_exchange.defvjp(_ragged_exchange_fwd, _ragged_exchange_bwd)
 
 
 def expert_capacity(tokens: int, experts: int, k: int,
@@ -84,6 +172,24 @@ def route_top_k(gates: jax.Array, k: int, capacity: int):
     return dispatch, combine, fraction
 
 
+def _seating_positions(keys: jax.Array, length: int):
+    """Rank each element among equals: position-in-group via one stable
+    argsort plus a scatter-inverted permutation.
+
+    ``keys`` are small non-negative integers (< ``length``); returns each
+    element's 0-based position among the elements sharing its key, in
+    stable (input) order — the seating primitive behind every sparse
+    dispatch path (sender compaction, receiver capacity, slot assignment),
+    kept single so the seating-order invariant cannot drift between them.
+    """
+    order = jnp.argsort(keys, stable=True)
+    # invert the permutation with one scatter (a second argsort is O(n log n))
+    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(order.size))
+    counts = jnp.bincount(keys, length=length)
+    starts = jnp.cumsum(counts) - counts
+    return ranks - starts[keys], counts
+
+
 def route_top_k_sparse(gates: jax.Array, k: int, capacity: int):
     """Sort-based routing: the O(tokens·k) replacement for the dense
     [tokens, experts, capacity] one-hot tensors (SURVEY §2.4 mandates
@@ -109,12 +215,7 @@ def route_top_k_sparse(gates: jax.Array, k: int, capacity: int):
     weights = top_gates.T.reshape(-1)
     token_ids = jnp.tile(jnp.arange(tokens), k)
 
-    order = jnp.argsort(expert_ids, stable=True)
-    # invert the permutation with one scatter (a second argsort is O(n log n))
-    ranks = jnp.zeros_like(order).at[order].set(jnp.arange(order.size))
-    counts = jnp.bincount(expert_ids, length=experts)
-    starts = jnp.cumsum(counts) - counts
-    position = ranks - starts[expert_ids]              # position within expert
+    position, _ = _seating_positions(expert_ids, experts)
     keep = position < capacity
     slots = jnp.where(keep, expert_ids * capacity + position,
                       experts * capacity)              # out of range = dropped
@@ -130,6 +231,21 @@ class MoEMLP(nn.Module):
     configured coefficients. Weights are stacked [experts, ...] float32
     masters cast to ``dtype`` per use; pass ``mesh`` to pin the dispatched
     activations to the expert axis (otherwise GSPMD chooses).
+
+    **Drop semantics across dispatch paths** (they agree exactly whenever
+    capacity is ample — no drops — which is the recommended operating
+    point): the dense and single-shard sparse paths seat tokens in global
+    choice-major order (every first choice before any second choice,
+    token-major within a choice). On a multi-device mesh the quota'd
+    sharded-sparse path (``exchange='quota'``, the ``'auto'``/``'sparse'``
+    default) instead decides drops *per sender*: each shard seats its own
+    assignments choice-major into a fixed per-expert quota (its
+    integer-truncated share of the capacity), so under tight capacity
+    *which* tokens overflow differs from the dense path, and a sender with
+    a locally-skewed routing drops tokens the global formulation would
+    seat. ``exchange='ragged'`` restores receiver-side global-order
+    seating within each expert-axis group (and moves only the actual
+    routed rows); see its docstring for the remaining cross-group caveat.
     """
 
     experts: int
@@ -141,6 +257,13 @@ class MoEMLP(nn.Module):
     z_coef: float = 1e-3
     mesh: object = None
     dispatch: str = 'auto'   # 'sparse' | 'dense' | 'auto'
+    # multi-device sparse exchange: 'quota' ships fixed per-sender quotas
+    # through a regular all_to_all (pads to the quota); 'ragged' ships the
+    # actual routed rows through jax.lax.ragged_all_to_all with
+    # receiver-side global-order capacity seating; 'ragged-emulated' is the
+    # same seating semantics over an all_gather transport for backends
+    # whose XLA cannot lower ragged-all-to-all (CPU test/virtual meshes)
+    exchange: str = 'quota'
 
     @nn.compact
     def __call__(self, hidden):
@@ -187,8 +310,16 @@ class MoEMLP(nn.Module):
         compute = jnp.dtype(self.dtype)
 
         if mode == 'sparse_sharded':
-            output, aux = self._sharded_sparse(flat, router, w1, b1, w2, b2,
-                                               compute)
+            if self.exchange in ('ragged', 'ragged-emulated'):
+                output, aux = self._sharded_ragged(flat, router, w1, b1, w2,
+                                                   b2, compute)
+            elif self.exchange == 'quota':
+                output, aux = self._sharded_sparse(flat, router, w1, b1, w2,
+                                                   b2, compute)
+            else:
+                raise ValueError(f'unknown exchange {self.exchange!r}; '
+                                 "expected 'quota', 'ragged' or "
+                                 "'ragged-emulated'")
             return output.reshape(*batch_shape, dim).astype(hidden.dtype), aux
 
         logits = flat.astype(jnp.float32) @ router
@@ -250,6 +381,14 @@ class MoEMLP(nn.Module):
         """Why the sharded sparse path cannot run (None = it can)."""
         from tpusystem.parallel.mesh import DATA, FSDP, MODEL, SEQ
         shape = dict(self.mesh.shape)
+        # the dispatch shard_map names all four row axes in its specs, so a
+        # hand-built mesh missing any of them must fall back to dense
+        # instead of raising a KeyError mid-trace
+        missing = [axis for axis in (DATA, FSDP, SEQ, EXPERT)
+                   if axis not in shape]
+        if missing:
+            return (f'mesh lacks the standard row axes {missing} the '
+                    'sparse dispatch shards over')
         shards = (shape.get(DATA, 1) * shape.get(FSDP, 1)
                   * shape.get(SEQ, 1) * shape.get(EXPERT, 1))
         if shape.get(MODEL, 1) > 1:
@@ -339,6 +478,168 @@ class MoEMLP(nn.Module):
                                           compute)
 
             # Switch balance/z losses over GLOBAL token statistics
+            fraction = lax.pmean(fraction, row_axes)
+            mean_gates = lax.pmean(jnp.mean(gates, axis=0), row_axes)
+            balance = experts * jnp.sum(fraction * mean_gates)
+            z_term = lax.pmean(
+                jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2), row_axes)
+            aux = self.balance_coef * balance + self.z_coef * z_term
+            return output, aux
+
+        return run(flat, router, w1, b1, w2, b2)
+
+    def _sharded_ragged(self, flat, router, w1, b1, w2, b2, compute):
+        """Expert-parallel sparse dispatch with a **ragged** exchange.
+
+        Differences from :meth:`_sharded_sparse` (the quota path):
+
+        * the exchange ships the *actual* routed rows —
+          ``jax.lax.ragged_all_to_all`` with per-destination offsets/sizes
+          (``exchange='ragged'``) or the all_gather emulation with
+          identical seating (``'ragged-emulated'``, for backends whose XLA
+          cannot lower the primitive) — instead of padding every sender to
+          a fixed per-expert quota; under balanced routing at capacity
+          factor ``c`` the quota path moves ``~c``x the bytes of this one.
+        * capacity is enforced at the **receiver** in global
+          ``(choice, token)`` order within the expert-axis group: every
+          row travels with a routing key, the expert's owner sorts its
+          arrivals and seats the first ``capacity`` — so a sender with
+          locally-skewed routing can fill seats the quota path would have
+          dropped (its fixed share) while another sender's quota sat
+          empty. Remaining divergence from the dense path: competition is
+          per expert-axis *group* (the data/fsdp/seq replicas of the
+          expert weights each seat their own token subset against a
+          proportional ``capacity``), so with drops the seated set matches
+          dense only when routing pressure is uniform across groups; with
+          ample capacity all paths agree exactly.
+        * a sender caps its per-expert sends at ``min(local_rows,
+          capacity)`` — rows beyond that could never seat anywhere, since
+          a sender's own assignments to one expert are already in global
+          order.
+
+        Both exchanges differentiate through :func:`_ragged_exchange`
+        (custom_vjp; the reverse exchange carries the cotangent home).
+        """
+        from tpusystem.parallel.mesh import DATA, FSDP, SEQ
+
+        mesh = self.mesh
+        expert_ax = mesh.shape[EXPERT]
+        local_experts = self.experts // expert_ax
+        shards = (mesh.shape[DATA] * mesh.shape[FSDP] * mesh.shape[SEQ]
+                  * expert_ax)
+        local_rows = flat.shape[0] // shards
+        dim = flat.shape[1]
+        experts, k = self.experts, self.k
+        group_tokens = local_rows * expert_ax
+        capacity = expert_capacity(group_tokens, experts, k,
+                                   self.capacity_factor)
+        send_cap = min(local_rows, capacity)
+        send_bound = min(local_rows * k, experts * send_cap)
+        recv_bound = expert_ax * local_experts * send_cap
+        key_span = k * expert_ax * local_rows
+        transport = 'ragged' if self.exchange == 'ragged' else 'gathered'
+        row_axes = (DATA, FSDP, SEQ, EXPERT)
+        row_spec = P(row_axes, None)
+
+        @functools.partial(
+            jax.shard_map, mesh=mesh, check_vma=False,
+            in_specs=(row_spec, P(), P(EXPERT, None, None), P(EXPERT, None),
+                      P(EXPERT, None, None), P(EXPERT, None)),
+            out_specs=(row_spec, P()))
+        def run(rows, router, w1, b1, w2, b2):
+            me = lax.axis_index(EXPERT)
+            logits = rows.astype(jnp.float32) @ router
+            gates = jax.nn.softmax(logits)
+            top_gates, top_experts = jax.lax.top_k(gates, k)
+            top_gates = top_gates / (jnp.sum(top_gates, -1, keepdims=True)
+                                     + 1e-9)
+            expert_ids = top_experts.T.reshape(-1)       # [k*L] choice-major
+            weights = top_gates.T.reshape(-1)
+            token_ids = jnp.tile(jnp.arange(local_rows), k)
+            choice_ids = jnp.arange(k * local_rows) // local_rows
+            # global (choice, token) seating key within the expert group
+            key = (choice_ids * (expert_ax * local_rows)
+                   + me * local_rows + token_ids).astype(jnp.int32)
+
+            # sender-side compaction: choice-major stable seating by expert
+            # is (expert, key) order within this sender, so keeping the
+            # first send_cap per expert keeps exactly the globally-seatable
+            # ones
+            position, counts = _seating_positions(expert_ids, experts)
+            keep = position < send_cap
+            counts_kept = jnp.minimum(counts, send_cap)
+            kept_starts = jnp.cumsum(counts_kept) - counts_kept
+            send_slot = jnp.where(keep, kept_starts[expert_ids] + position,
+                                  send_bound)
+
+            send_rows = jnp.zeros((send_bound, dim), compute)
+            send_rows = send_rows.at[send_slot].set(
+                rows.astype(compute)[token_ids], mode='drop')
+            sentinel_row = jnp.asarray([[experts, key_span]], jnp.int32)
+            send_meta = jnp.tile(sentinel_row, (send_bound, 1))
+            send_meta = send_meta.at[send_slot].set(
+                jnp.stack([expert_ids.astype(jnp.int32), key], axis=1),
+                mode='drop')
+
+            # exchange geometry from the gathered count matrix
+            dev_counts = counts_kept.reshape(expert_ax, local_experts).sum(
+                axis=1).astype(jnp.int32)
+            in_off = (jnp.cumsum(dev_counts) - dev_counts).astype(jnp.int32)
+            counts_mat = lax.all_gather(dev_counts, EXPERT)  # [sender, dest]
+            recv_sz = counts_mat[:, me]
+            recv_off = (jnp.cumsum(recv_sz) - recv_sz).astype(jnp.int32)
+            out_off = (jnp.cumsum(counts_mat, axis=0) - counts_mat)[me]
+            rev_out_off = (jnp.cumsum(counts_mat, axis=1)
+                           - counts_mat)[:, me].astype(jnp.int32)
+            out_off = out_off.astype(jnp.int32)
+
+            recv_rows = _ragged_exchange(
+                transport, EXPERT, send_rows,
+                jnp.zeros((recv_bound, dim), compute),
+                in_off, dev_counts, out_off, recv_off, recv_sz, rev_out_off)
+            recv_meta = _ragged_transport(
+                transport, EXPERT, send_meta,
+                jnp.tile(sentinel_row, (recv_bound, 1)),
+                in_off, dev_counts, out_off, recv_sz)
+
+            # receiver-side seating in global (choice, token) order
+            r_expert, r_key = recv_meta[:, 0], recv_meta[:, 1]
+            valid = r_expert < experts
+            local_e = jnp.clip(r_expert - me * local_experts, 0,
+                               local_experts - 1)
+            seat_key = jnp.where(valid, local_e * key_span + r_key,
+                                 local_experts * key_span)
+            order2 = jnp.argsort(seat_key, stable=True)
+            ranks2 = jnp.zeros_like(order2).at[order2].set(
+                jnp.arange(order2.size))
+            e_counts = jnp.bincount(
+                jnp.where(valid, local_e, local_experts),
+                length=local_experts + 1)[:local_experts]
+            e_starts = jnp.cumsum(e_counts) - e_counts
+            position2 = ranks2 - e_starts[local_e]
+            seat = valid & (position2 < capacity)
+            slot2 = jnp.where(seat, local_e * capacity + position2,
+                              local_experts * capacity)
+
+            expert_in = jnp.zeros((local_experts * capacity, dim), compute)
+            expert_in = expert_in.at[slot2].set(recv_rows, mode='drop')
+            expert_in = expert_in.reshape(local_experts, capacity, dim)
+
+            shrunk = self._ffn(expert_in, w1, b1, w2, b2, compute)
+
+            buffer = shrunk.reshape(local_experts * capacity, dim)
+            out_rows = buffer.at[slot2].get(mode='fill', fill_value=0)
+            returned = _ragged_exchange(
+                transport, EXPERT, out_rows,
+                jnp.zeros((send_bound, dim), compute),
+                recv_off, recv_sz, rev_out_off, in_off, dev_counts, out_off)
+            gathered = returned.at[send_slot].get(mode='fill', fill_value=0)
+            output = jnp.zeros((local_rows, dim), compute).at[token_ids].add(
+                gathered * weights[:, None].astype(compute))
+
+            # Switch balance/z losses over GLOBAL token statistics
+            fraction = jnp.mean(jax.nn.one_hot(top_experts[:, 0], experts),
+                                axis=0)
             fraction = lax.pmean(fraction, row_axes)
             mean_gates = lax.pmean(jnp.mean(gates, axis=0), row_axes)
             balance = experts * jnp.sum(fraction * mean_gates)

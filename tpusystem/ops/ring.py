@@ -5,21 +5,34 @@ chunks rotate around the ICI ring via ``ppermute``; each device accumulates
 blockwise-softmax partial results, so a sequence of length S costs each
 device O(S/n) memory and the full S^2 attention FLOPs are spread n ways.
 
-Two variants:
+Three variants:
 
-* :func:`ring_attention` — the ppermute ring, callable **inside**
-  ``shard_map`` on seq-sharded [B, S/n, H, D] chunks. Each rotating KV
-  chunk is attended with the Pallas **flash kernel** and partials merge by
-  logsumexp weights, so per-device memory stays O(S/n) even inside the
-  chunk. Differentiable end to end (``ppermute`` has a transpose rule; the
-  kernel's custom_vjp accepts the lse cotangent the merge produces).
+* :func:`zigzag_ring_attention` — the **causal** ring. Each device holds a
+  zigzag stripe pair (stripe ``i`` and stripe ``2n-1-i`` of ``2n``), which
+  balances causal work perfectly: every device computes exactly the visible
+  half of each arriving KV pair instead of computing the full block and
+  masking half of it away (the contiguous-layout ring wastes ~2x FLOPs on
+  discarded future chunks, and rank 0 idles while rank n-1 sweats). The
+  next step's ``ppermute`` is issued *before* the current step's flash
+  calls so XLA's latency-hiding scheduler can overlap transfer with
+  compute (SURVEY.md §7.3: "overlap ppermute with compute").
+* :func:`ring_attention` — the contiguous-layout ring, kept for the
+  non-causal case (where every chunk is visible and there is nothing to
+  skip) and for sequence lengths the zigzag split cannot tile.
 * :func:`ulysses_attention` — the all-to-all head/sequence swap (DeepSpeed
   Ulysses): transposes shards so each device holds *all* positions for a
   subset of heads, runs flash attention locally, swaps back. Cheaper
   collectives for moderate contexts; requires heads % ring_size == 0.
 
+Each rotating KV chunk is attended with the Pallas **flash kernel** and
+partials merge by logsumexp weights, so per-device memory stays O(S/n)
+even inside the chunk. Differentiable end to end (``ppermute`` has a
+transpose rule; the kernel's custom_vjp accepts the lse cotangent the
+merge produces).
+
 The outer convenience :func:`ring_self_attention` wires the ``shard_map``
-over a mesh for both.
+over a mesh for all variants; causal ``'ring'`` auto-upgrades to zigzag
+whenever the sequence length allows.
 """
 
 from __future__ import annotations
@@ -54,9 +67,31 @@ def _attention_lse(query, key, value, *, causal, scale, inner):
                      "expected 'flash' or 'einsum'")
 
 
+def _merge_lse(out, lse, new_out, new_lse):
+    """Fold a new ``(out, lse)`` partial into the f32 accumulator pair.
+
+    Exact blockwise softmax: both partials are weighted by
+    ``exp(lse - logaddexp(lse, new_lse))``. A partial carrying
+    ``lse = NEG_INF`` contributes exactly zero, so masked-out blocks fold
+    to a no-op.
+    """
+    merged = jnp.logaddexp(lse, new_lse)
+    weight_old = jnp.exp(lse - merged)[..., None]
+    weight_new = jnp.exp(new_lse - merged)[..., None]
+    return out * weight_old + new_out.astype(jnp.float32) * weight_new, merged
+
+
+def _ring_permute(axis: str, ring: int):
+    def permute(tensor):
+        return lax.ppermute(
+            tensor, axis,
+            [(source, (source + 1) % ring) for source in range(ring)])
+    return permute
+
+
 def ring_attention(query, key, value, *, axis: str = SEQ, causal: bool = True,
                    scale: float | None = None, inner: str = 'flash'):
-    """Blockwise ring attention. Call inside ``shard_map``.
+    """Blockwise ring attention, contiguous layout. Call inside ``shard_map``.
 
     K/V chunks rotate around the ring; each arriving chunk is attended with
     the **flash kernel** and the per-chunk ``(out, lse)`` partials merge by
@@ -65,6 +100,11 @@ def ring_attention(query, key, value, *, axis: str = SEQ, causal: bool = True,
     chunk causally, and every later step's chunk is either strictly past
     (fully visible, non-causal flash) or strictly future (discarded by
     setting its merge weight to exp(-inf)).
+
+    Note the causal case pays for every discarded future chunk and leaves
+    early ranks idle-equivalent — :func:`zigzag_ring_attention` is the
+    balanced formulation and is what :func:`ring_self_attention` selects
+    for causal use; this contiguous form remains the non-causal path.
 
     Args:
         query/key/value: local chunks [batch, chunk, heads, head_dim] of a
@@ -78,19 +118,21 @@ def ring_attention(query, key, value, *, axis: str = SEQ, causal: bool = True,
     rank = lax.axis_index(axis)
     head_dim = query.shape[-1]
     scale = scale if scale is not None else head_dim ** -0.5
-
-    def permute(tensor):
-        return lax.ppermute(
-            tensor, axis,
-            [(source, (source + 1) % ring) for source in range(ring)])
+    permute = _ring_permute(axis, ring)
 
     # step 0: own chunk (the causal diagonal block)
     out, lse = _attention_lse(query, key, value, causal=causal, scale=scale,
                               inner=inner)
     out = out.astype(jnp.float32)
 
+    # the chunk for step s+1 is always already in flight before step s's
+    # attention runs, so the transfer can hide under the flash call
+    if ring > 1:
+        key_next, value_next = permute(key), permute(value)
     for step in range(1, ring):
-        key, value = permute(key), permute(value)
+        key, value = key_next, value_next
+        if step + 1 < ring:
+            key_next, value_next = permute(key), permute(value)
         # we now hold the chunk of rank (rank - step) % ring: strictly past
         # iff rank >= step, strictly future otherwise (causal only)
         chunk_out, chunk_lse = _attention_lse(query, key, value, causal=False,
@@ -99,13 +141,159 @@ def ring_attention(query, key, value, *, axis: str = SEQ, causal: bool = True,
             visible = rank >= step
             chunk_lse = jnp.where(visible, chunk_lse, NEG_INF)
             chunk_out = jnp.where(visible, chunk_out, 0)
-        merged = jnp.logaddexp(lse, chunk_lse)
-        weight_old = jnp.exp(lse - merged)[..., None]
-        weight_new = jnp.exp(chunk_lse - merged)[..., None]
-        out = out * weight_old + chunk_out.astype(jnp.float32) * weight_new
-        lse = merged
+        out, lse = _merge_lse(out, lse, chunk_out, chunk_lse)
 
     return out.astype(query.dtype)
+
+
+def _even_home(stripe: int, ring: int) -> int:
+    """Zigzag owner of global stripe ``stripe`` (of ``2 * ring``)."""
+    return stripe if stripe < ring else 2 * ring - 1 - stripe
+
+
+def _to_zigzag(tensor, axis: str, ring: int):
+    """Contiguous local chunk -> (low, high) zigzag stripe pair.
+
+    Contiguous layout: device ``i`` holds global stripes ``(2i, 2i+1)`` as
+    the two halves of its chunk. Zigzag layout: device ``i`` holds stripes
+    ``(i, 2n-1-i)``. The exchange is two half-chunk ``ppermute``s: one
+    routing every even-indexed stripe to its zigzag home, one routing the
+    odd stripes — each is a valid device permutation because every device
+    owns exactly one even and one odd stripe in both layouts. The receiver
+    sorts its two arrivals into (low, high) by its own rank parity
+    (stripe ``i`` and stripe ``2n-1-i`` always have opposite parity).
+    """
+    rank = lax.axis_index(axis)
+    half = tensor.shape[1] // 2
+    first, second = tensor[:, :half], tensor[:, half:]  # stripes 2i, 2i+1
+    recv_even = lax.ppermute(
+        first, axis, [(i, _even_home(2 * i, ring)) for i in range(ring)])
+    recv_odd = lax.ppermute(
+        second, axis, [(i, _even_home(2 * i + 1, ring)) for i in range(ring)])
+    even_rank = (rank % 2) == 0
+    low = jnp.where(even_rank, recv_even, recv_odd)    # stripe rank
+    high = jnp.where(even_rank, recv_odd, recv_even)   # stripe 2n-1-rank
+    return low, high
+
+
+def _from_zigzag(low, high, axis: str, ring: int):
+    """Inverse of :func:`_to_zigzag`: stripe pair -> contiguous chunk."""
+    rank = lax.axis_index(axis)
+    even_rank = (rank % 2) == 0
+    # device a holds stripes (a, 2n-1-a); its even stripe is `a` when a is
+    # even (the low slot), else `2n-1-a` (the high slot)
+    payload_even = jnp.where(even_rank, low, high)
+    payload_odd = jnp.where(even_rank, high, low)
+    even_stripe = lambda a: a if a % 2 == 0 else 2 * ring - 1 - a
+    odd_stripe = lambda a: a if a % 2 == 1 else 2 * ring - 1 - a
+    first = lax.ppermute(
+        payload_even, axis,
+        [(a, even_stripe(a) // 2) for a in range(ring)])   # stripe 2i
+    second = lax.ppermute(
+        payload_odd, axis,
+        [(a, odd_stripe(a) // 2) for a in range(ring)])    # stripe 2i+1
+    return jnp.concatenate([first, second], axis=1)
+
+
+def zigzag_ring_attention(query, key, value, *, axis: str = SEQ,
+                          scale: float | None = None, inner: str = 'flash'):
+    """Causal ring attention with balanced zigzag stripes. Call inside
+    ``shard_map``.
+
+    The contiguous-layout causal ring computes every arriving KV chunk in
+    full and discards the strictly-future ones — on an n-way ring that is
+    ~2x the necessary FLOPs, concentrated on the high ranks while rank 0
+    idles. Here the global sequence is viewed as ``2n`` stripes and device
+    ``i`` holds the pair ``(i, 2n-1-i)``, so every device's visible work is
+    identical at every step:
+
+    * step 0 (own pair): ``q_low @ kv_low`` causal, ``q_high @ kv_low``
+      full, ``q_high @ kv_high`` causal — the diagonal.
+    * step s, KV pair arriving from rank ``j = (rank - s) mod n``:
+      ``q_high @ kv_low`` is *always* fully visible (stripe ``j < n`` is
+      always in the past of stripe ``2n-1-rank >= n``). The second visible
+      block is ``q_low @ kv_low`` when ``j < rank`` and
+      ``q_high @ kv_high`` when ``j > rank`` — same shapes either way, so
+      it is computed once on ``where``-selected inputs: no ``lax.cond``,
+      no masked discards, perfectly balanced SPMD.
+
+    Every step therefore runs exactly 2 stripe-sized flash blocks
+    (vs 4 stripe-blocks per step for the contiguous ring): per-device
+    attention work is ``(2n+1)`` stripe-blocks vs ``4n`` — the ~2x saving,
+    verified by ``tests/test_attention.py::test_zigzag_halves_ring_flops``.
+
+    The KV pair for step s+1 is ``ppermute``d before step s's flash calls,
+    so the ICI transfer overlaps the compute (SURVEY.md §7.3).
+
+    Inputs arrive in the ordinary contiguous layout ([batch, chunk, heads,
+    head_dim], chunk ``2c`` = stripes ``2i, 2i+1``); the zigzag exchange in
+    and out of stripe layout is two half-chunk ``ppermute``s each way.
+    Requires an even local chunk. Differentiable end to end.
+    """
+    ring = lax.axis_size(axis)
+    head_dim = query.shape[-1]
+    scale = scale if scale is not None else head_dim ** -0.5
+    if ring == 1:
+        out, _ = _attention_lse(query, key, value, causal=True, scale=scale,
+                                inner=inner)
+        return out
+    assert query.shape[1] % 2 == 0, (
+        f'zigzag ring needs an even local chunk, got {query.shape[1]}')
+    rank = lax.axis_index(axis)
+    permute = _ring_permute(axis, ring)
+
+    q_low, q_high = _to_zigzag(query, axis, ring)
+    k_low, k_high = _to_zigzag(key, axis, ring)
+    v_low, v_high = _to_zigzag(value, axis, ring)
+    kv = (k_low, k_high, v_low, v_high)
+
+    # rotate before computing the diagonal so step 1's pair is in flight
+    # under the three step-0 flash calls
+    kv_next = jax.tree.map(permute, kv)
+
+    # step 0: the diagonal of the device's own stripe pair
+    out_low, lse_low = _attention_lse(q_low, k_low, v_low, causal=True,
+                                      scale=scale, inner=inner)
+    out_low = out_low.astype(jnp.float32)
+    out_high, lse_high = _attention_lse(q_high, k_low, v_low, causal=False,
+                                        scale=scale, inner=inner)
+    out_high = out_high.astype(jnp.float32)
+    part_out, part_lse = _attention_lse(q_high, k_high, v_high, causal=True,
+                                        scale=scale, inner=inner)
+    out_high, lse_high = _merge_lse(out_high, lse_high, part_out, part_lse)
+
+    for step in range(1, ring):
+        kv = kv_next
+        if step + 1 < ring:
+            kv_next = jax.tree.map(permute, kv)
+        arriving_k_low, arriving_k_high, arriving_v_low, arriving_v_high = kv
+        source = (rank - step) % ring   # rank whose stripe pair just arrived
+        # block 1: q_high x kv_low — visible for every source (low stripes
+        # precede all high stripes)
+        part_out, part_lse = _attention_lse(
+            q_high, arriving_k_low, arriving_v_low, causal=False, scale=scale,
+            inner=inner)
+        out_high, lse_high = _merge_lse(out_high, lse_high, part_out, part_lse)
+        # block 2: the past-dependent block, computed once on selected
+        # inputs — q_low x kv_low when the source is in the past,
+        # q_high x kv_high when it is in the future
+        past = source < rank
+        query_sel = jnp.where(past, q_low, q_high)
+        key_sel = jnp.where(past, arriving_k_low, arriving_k_high)
+        value_sel = jnp.where(past, arriving_v_low, arriving_v_high)
+        part_out, part_lse = _attention_lse(query_sel, key_sel, value_sel,
+                                            causal=False, scale=scale,
+                                            inner=inner)
+        out_low, lse_low = _merge_lse(
+            out_low, lse_low,
+            jnp.where(past, part_out, 0), jnp.where(past, part_lse, NEG_INF))
+        out_high, lse_high = _merge_lse(
+            out_high, lse_high,
+            jnp.where(past, 0, part_out), jnp.where(past, NEG_INF, part_lse))
+
+    out = _from_zigzag(out_low.astype(query.dtype),
+                       out_high.astype(query.dtype), axis, ring)
+    return out
 
 
 def ulysses_attention(query, key, value, *, axis: str = SEQ,
@@ -142,14 +330,38 @@ def ring_self_attention(query, key, value, mesh, *, causal: bool = True,
     Inputs are global [B, S, H, D]; batch shards over (data, fsdp), sequence
     over seq. ``inner`` selects ring's per-chunk kernel ('flash'|'einsum').
     Useful standalone and as the reference harness for tests.
+
+    ``variant='ring'`` auto-selects the balanced zigzag formulation for
+    causal attention whenever the sequence splits into ``2 * seq_axis``
+    stripes (the ~2x FLOPs saving — see :func:`zigzag_ring_attention`),
+    falling back to the contiguous ring otherwise. ``'zigzag'`` forces it
+    (raising when the shape cannot stripe); ``'ulysses'`` is the
+    all-to-all variant.
     """
-    if variant == 'ring':
-        implementation = functools.partial(ring_attention, inner=inner)
+    seq_size = mesh.shape[SEQ]
+    stripeable = (causal and seq_size > 0
+                  and query.shape[1] % (2 * seq_size) == 0)
+    if variant == 'zigzag':
+        if not causal:
+            raise ValueError('zigzag ring attention is causal-only; use '
+                             "variant='ring' for non-causal")
+        if not stripeable:
+            raise ValueError(
+                f'zigzag needs seq length {query.shape[1]} divisible by '
+                f'2 * seq axis ({2 * seq_size})')
+    if variant == 'ring' and stripeable:
+        variant = 'zigzag'
+
+    if variant == 'zigzag':
+        implementation = functools.partial(zigzag_ring_attention, inner=inner)
+    elif variant == 'ring':
+        implementation = functools.partial(ring_attention, causal=causal,
+                                           inner=inner)
     elif variant == 'ulysses':
-        implementation = ulysses_attention
+        implementation = functools.partial(ulysses_attention, causal=causal)
     else:
         raise ValueError(f'unknown variant {variant!r}; '
-                         "expected 'ring' or 'ulysses'")
+                         "expected 'ring', 'zigzag' or 'ulysses'")
     data_parallel = mesh.shape[DATA] * mesh.shape[FSDP]
     # batch shards over (data, fsdp) when divisible (e.g. module.init traces
     # with batch 1 — replicate batch there, shard only the sequence)
@@ -162,6 +374,6 @@ def ring_self_attention(query, key, value, mesh, *, causal: bool = True,
         jax.shard_map, mesh=mesh, check_vma=False,
         in_specs=(spec, spec, spec), out_specs=spec)
     def mapped(q, k, v):
-        return implementation(q, k, v, causal=causal)
+        return implementation(q, k, v)
 
     return mapped(query, key, value)

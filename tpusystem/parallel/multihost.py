@@ -387,6 +387,19 @@ class Hub:
                 stale = [(rank, seen) for rank, seen in self._last_seen.items()
                          if now - seen > self.heartbeat_timeout
                          and rank not in self._lost]
+                if self._standby.is_set():
+                    # a STANDBY deputy never judges (same guard as
+                    # _client_loop): clients touch it only transiently and
+                    # send no heartbeats here, so a stale entry means a
+                    # flaked probe, not a dead rank — drop the socket and
+                    # liveness entry instead of excluding a healthy rank
+                    # from the quota for the deputy's post-promotion life
+                    for rank, _ in stale:
+                        sock = self._clients.pop(rank, None)
+                        self._last_seen.pop(rank, None)
+                        if sock is not None:
+                            sock.close()
+                    continue
                 self._lost.update(rank for rank, _ in stale)
                 self._excluded.update(rank for rank, _ in stale)
             for rank, seen in stale:

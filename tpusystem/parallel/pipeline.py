@@ -132,29 +132,77 @@ def _stage_scan(block_fn: BlockFn):
     return run
 
 
+def _stash_slots(stages: int, interleave: int, microbatches: int) -> int:
+    """Smallest per-chunk stash size such that ``m % slots`` indexing never
+    clobbers a live microbatch input.
+
+    A chunk input written at its forward tick must survive until its
+    backward tick; reuse of slot ``m % slots`` by microbatch ``m + slots``
+    is safe iff that later forward happens strictly after this backward.
+    Checked directly against the schedule formulas (see
+    :func:`pipeline_train`); for ``interleave == 1`` this recovers the
+    classic 1F1B bound ``2 * stages - 1``.
+    """
+    def fwd_tick(c, s, m):
+        group, pos = divmod(m, stages)
+        return s + group * interleave * stages + c * stages + pos
+
+    def bwd_tick(c, s, m):
+        group, pos = divmod(m, stages)
+        return ((interleave * stages + stages - 2 - s)
+                + group * interleave * stages
+                + (interleave - 1 - c) * stages + pos)
+
+    for slots in range(1, microbatches + 1):
+        if all(fwd_tick(c, s, m + slots) > bwd_tick(c, s, m)
+               for c in range(interleave) for s in range(stages)
+               for m in range(microbatches - slots)):
+            return slots
+    return microbatches
+
+
 def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
-                   weight_fn=None):
+                   weight_fn=None, interleave: int = 1):
     """1F1B-scheduled pipelined loss + gradients (one combined pass).
 
     The GPipe path (:func:`pipeline_apply` under ``jax.grad``) stashes
     O(microbatches) activations per stage because the backward replays the
-    whole forward scan in reverse. This schedule interleaves: in round
-    ``r`` stage ``s`` *forwards* microbatch ``r - s`` and *backwards*
-    microbatch ``r - (2*stages - 2 - s)``, so a microbatch's backward runs
-    at most ``2*(stages - 1 - s) + 1`` rounds after its forward and the
-    per-stage stash is bounded by ``2*stages - 1`` microbatch *inputs*
-    (block outputs are rematerialized in the backward ``jax.vjp``),
-    independent of the microbatch count — the activation-memory lever for
-    deep pipes. The last stage backwards each microbatch in the same round
-    it forwards it (classic 1F1B).
+    whole forward scan in reverse. This schedule interleaves forwards with
+    backwards, so a microbatch's backward runs a bounded number of ticks
+    after its forward and the per-stage stash is bounded independent of
+    the microbatch count (block outputs are rematerialized in the backward
+    ``jax.vjp``) — the activation-memory lever for deep pipes. The last
+    stage backwards each microbatch in the same tick it forwards it
+    (classic 1F1B).
 
-    Because every stage executes masked forward+backward units every
-    round, total compute is ``(microbatches + 2*stages - 2)`` round-units
-    against GPipe's ``microbatches + stages - 1`` — memory is bought with
-    bubble FLOPs, so prefer this when activations, not time, are the
-    binding constraint. The head and tail (embedding / LM-head + loss) run
-    only on their own stage: inside ``shard_map``, ``lax.cond`` on a
-    device-varying predicate is real per-device control flow.
+    **Interleaved (circular) schedule** (``interleave = v > 1``): each
+    device owns ``v`` *non-contiguous* layer chunks — virtual stage
+    ``q = c * stages + s`` lives on device ``s`` — and microbatches travel
+    the ring ``v`` times through chunk-sized units. With ``S`` stages and
+    ``M`` microbatches the tick count is ``vM + vS + S - 2`` chunk-units
+    against plain 1F1B's ``(M + 2S - 2)`` stage-units = ``v(M + 2S - 2)``
+    chunk-units: the pipeline fill/drain bubble shrinks from ``~2S`` stage
+    units toward ``~S/v`` stage units. Schedule (tick ``r``, device ``s``,
+    groups of ``S`` microbatches per chunk sweep):
+
+    * forward: unit index ``i = r - s`` (active while ``0 <= i < vM``),
+      group ``g = i // (vS)``, chunk ``c_f = (i % (vS)) // S``, microbatch
+      ``m_f = gS + i % S``.
+    * backward: ``j = r - (vS + S - 2 - s)``, group ``g = j // (vS)``,
+      chunk ``c_b = v - 1 - (j % (vS)) // S``, microbatch
+      ``m_b = gS + j % S``.
+
+    Every dependency (virtual stage ``q`` before ``q+1``, forward before
+    backward, one-tick ``ppermute`` latency on both rings) holds with
+    equality along the critical path, and for ``v = 1`` the formulas
+    reduce exactly to classic 1F1B (forward ``r - s``, backward
+    ``r - (2S - 2 - s)``).
+
+    Idle units cost (almost) nothing: the head, the tail, *and* each block
+    forward/backward unit sit under ``lax.cond`` — inside ``shard_map``,
+    ``lax.cond`` on a device-varying predicate is real per-device control
+    flow, so fill/drain ticks skip the block compute instead of executing
+    it masked.
 
     No autodiff runs through the round loop: gradients are accumulated
     explicitly, so ``jax.grad`` of the caller is neither needed nor
@@ -162,22 +210,32 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
 
     Args:
         head_fn: ``(replicated_params, micro_inputs) -> activations`` —
-            the pre-pipe part (embeddings), executed at stage 0.
+            the pre-pipe part (embeddings), executed at stage 0 (chunk 0).
         block_fn: ``(layer_params, x) -> x`` per layer; layers stacked and
             stage-sharded as in :func:`pipeline_apply`.
         tail_fn: ``(replicated_params, activations, micro_targets) ->
             scalar mean loss`` — the post-pipe part (final norm, LM head,
-            criterion), executed at the last stage. ``replicated_params``
-            is ONE pytree shared by head and tail (a tied embedding
-            appears in both; its two gradient contributions are summed).
+            criterion), executed at the last stage (last chunk).
+            ``replicated_params`` is ONE pytree shared by head and tail (a
+            tied embedding appears in both; its two gradient contributions
+            are summed).
         mesh: mesh with ``stage`` (and optionally data/fsdp) axes.
         microbatches: microbatches per step; batch must divide by
-            ``data*fsdp*microbatches``.
+            ``data*fsdp*microbatches``. With interleave the schedule
+            sweeps chunks in groups of ``stages`` microbatches; a
+            remainder group is padded with idle units (correct, slightly
+            more bubble), so prefer ``microbatches % stages == 0``.
         weight_fn: optional ``(micro_targets) -> scalar`` microbatch weight
             (the masked LM losses' unmasked-token count) — the same
             weighting ``build_train_step(accumulate=...)`` applies, so
             padded microbatches reproduce the full-batch mean. ``None``
             weighs microbatches equally.
+        interleave: virtual-pipeline chunks per device. ``1`` = classic
+            1F1B over contiguous stage slices (stacked leaves
+            ``[layers, ...]``, sharded ``P(stage)``); ``v > 1`` expects
+            stacked leaves reshaped to ``[v, layers/v, ...]`` (a plain
+            reshape of the layer-major stack) sharded ``P(None, stage)``,
+            so device ``s`` holds layers ``{(c*S + s) * Lc + j}``.
 
     Returns:
         ``step(replicated_params, stacked_params, inputs, targets) ->
@@ -188,8 +246,15 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
     stages = mesh.shape[STAGE]
     data_parallel = mesh.shape[DATA] * mesh.shape[FSDP]
     batch_axes = (DATA, FSDP) if data_parallel > 1 else None
-    slots = 2 * stages - 1
-    rounds = microbatches + 2 * stages - 2
+    chunks = interleave
+    slots = _stash_slots(stages, chunks, microbatches)
+    # the interleaved schedule sweeps each chunk over groups of `stages`
+    # microbatches; a partial last group is padded with idle units (clipped
+    # microbatch indices would silently duplicate/skip work). For chunks==1
+    # the group decomposition is exact for any microbatch count.
+    padded = (microbatches if chunks == 1
+              else -(-microbatches // stages) * stages)
+    rounds = chunks * padded + chunks * stages + stages - 2
     stage_body = _stage_scan(block_fn)
 
     def step(replicated_params, stacked_params, inputs, targets):
@@ -199,7 +264,8 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 f'data*fsdp*microbatches = {data_parallel}*{microbatches}')
 
         batch_spec = P(batch_axes)
-        param_specs = jax.tree.map(lambda _: P(STAGE), stacked_params)
+        chunk_spec = P(STAGE) if chunks == 1 else P(None, STAGE)
+        param_specs = jax.tree.map(lambda _: chunk_spec, stacked_params)
 
         @functools.partial(
             jax.shard_map, mesh=mesh, check_vma=False,
@@ -212,6 +278,21 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 (microbatches, a.shape[0] // microbatches) + a.shape[1:])
             micro_in, micro_tgt = micro(local_inputs), micro(local_targets)
 
+            # unify layouts: local chunk stack [chunks, layers/chunk, ...]
+            # (for chunks == 1 the P(stage) local slice [layers/S, ...]
+            # gains a unit leading dim; grads reshape back at the end)
+            stacked_in = stacked
+            if chunks == 1:
+                stacked = jax.tree.map(lambda leaf: leaf[None], stacked)
+
+            def chunk_params(tree, c):
+                if chunks == 1:
+                    return jax.tree.map(lambda leaf: leaf[0], tree)
+                return jax.tree.map(
+                    lambda leaf: lax.dynamic_index_in_dim(leaf, c, 0,
+                                                          keepdims=False),
+                    tree)
+
             sample = head_fn(reps, micro_in[0])
             zero_act = jnp.zeros_like(sample)
             # gradient accumulators in float32 regardless of param dtype
@@ -221,7 +302,7 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
             carry = dict(
                 fwd_msg=zero_act,
                 bwd_msg=jnp.zeros_like(sample),
-                stash=jnp.zeros((slots,) + sample.shape, sample.dtype),
+                stash=jnp.zeros((chunks, slots) + sample.shape, sample.dtype),
                 d_stacked=zeros_f32(stacked),
                 d_reps=zeros_f32(reps),
                 loss=jnp.float32(0),
@@ -230,32 +311,47 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
 
             perm_fwd = [(i, (i + 1) % count) for i in range(count)]
             perm_bwd = [(i, (i - 1) % count) for i in range(count)]
+            span = chunks * count    # ticks per (group, chunk) sweep
+
+            def schedule(unit):
+                """Unit index -> (active, chunk, microbatch)."""
+                group, rem = jnp.divmod(unit, span)
+                chunk, pos = jnp.divmod(rem, count)
+                m = group * count + pos
+                # padding units of a partial last group are idle, never
+                # clipped onto a real microbatch (that would duplicate it)
+                active = ((unit >= 0) & (unit < chunks * padded)
+                          & (m < microbatches))
+                return (active, jnp.clip(chunk, 0, chunks - 1),
+                        jnp.clip(m, 0, microbatches - 1))
 
             def round_body(carry, r):
-                m_f = r - stage
-                active_f = (m_f >= 0) & (m_f < microbatches)
-                m_f_safe = jnp.clip(m_f, 0, microbatches - 1)
-                feed = lax.dynamic_index_in_dim(micro_in, m_f_safe,
-                                                keepdims=False)
+                active_f, c_f_raw, m_f = schedule(r - stage)
+                c_f = c_f_raw
+                feed = lax.dynamic_index_in_dim(micro_in, m_f, keepdims=False)
                 # inside shard_map, lax.cond on a device-varying predicate
                 # is real per-device control flow: only stage 0 pays for the
-                # embedding, only the last stage for the tail fwd+bwd below
-                x = lax.cond(stage == 0,
+                # embedding, only the last stage for the tail fwd+bwd below,
+                # and fill/drain ticks skip the block unit entirely
+                x = lax.cond((stage == 0) & (c_f == 0),
                              lambda: head_fn(reps, feed),
                              lambda: carry['fwd_msg'])
+                params_f = chunk_params(stacked, c_f)
+                y = lax.cond(active_f,
+                             lambda: stage_body(params_f, x),
+                             lambda: zero_act)
                 stash = jnp.where(
                     active_f,
-                    lax.dynamic_update_index_in_dim(
-                        carry['stash'], x, m_f_safe % slots, 0),
+                    lax.dynamic_update_slice(
+                        carry['stash'], x[None, None],
+                        (c_f, m_f % slots) + (0,) * x.ndim),
                     carry['stash'])
-                y = stage_body(stacked, x)
 
-                # tail: the last stage turns its fresh forward into a loss
-                # and a cotangent seed in the same round (1F1B)
-                tgt = lax.dynamic_index_in_dim(micro_tgt, m_f_safe,
-                                               keepdims=False)
+                # tail: the last stage turns its final-chunk forward into a
+                # loss and a cotangent seed in the same tick (1F1B)
+                tgt = lax.dynamic_index_in_dim(micro_tgt, m_f, keepdims=False)
                 is_last = stage == count - 1
-                active_t = active_f & is_last
+                active_t = active_f & is_last & (c_f == chunks - 1)
 
                 def run_tail():
                     loss_m, (d_tail_m, dy) = jax.value_and_grad(
@@ -276,27 +372,48 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                                                      loss_m * weight, 0)
                 weight_acc = carry['weight'] + jnp.where(active_t, weight, 0)
 
-                # backward unit: recompute this stage's forward from the
+                # backward unit: recompute this chunk's forward from the
                 # stashed input (rematerialization) and pull grads through
-                m_b = r - (2 * count - 2 - stage)
-                active_b = (m_b >= 0) & (m_b < microbatches)
-                m_b_safe = jnp.clip(m_b, 0, microbatches - 1)
-                x_saved = lax.dynamic_index_in_dim(stash, m_b_safe % slots,
-                                                   keepdims=False)
-                cot = jnp.where(is_last, dy, carry['bwd_msg'])
-                _, vjp_fn = jax.vjp(stage_body, stacked, x_saved)
-                d_stacked_m, dx = vjp_fn(cot.astype(y.dtype))
-                accumulate = lambda acc_tree, grad_tree, condition: jax.tree.map(
-                    lambda acc, g: acc + jnp.where(condition,
-                                                   g.astype(jnp.float32), 0),
-                    acc_tree, grad_tree)
-                d_stacked = accumulate(carry['d_stacked'], d_stacked_m,
-                                       active_b)
+                active_b, c_b_rev, m_b = schedule(
+                    r - (chunks * count + count - 2 - stage))
+                c_b = chunks - 1 - c_b_rev
+                x_saved = lax.dynamic_slice(
+                    stash, (c_b, m_b % slots) + (0,) * sample.ndim,
+                    (1, 1) + sample.shape)
+                x_saved = jnp.squeeze(x_saved, axis=(0, 1))
+                # the last stage's final-chunk backward consumes the dy it
+                # just produced; every other unit consumes the ring message
+                cot = jnp.where(is_last & (c_b == chunks - 1), dy,
+                                carry['bwd_msg'])
+                params_b = chunk_params(stacked, c_b)
 
-                # stage 0's input cotangent flows into the head (embeddings)
-                feed_b = lax.dynamic_index_in_dim(micro_in, m_b_safe,
+                def run_bwd():
+                    _, vjp_fn = jax.vjp(stage_body, params_b, x_saved)
+                    return vjp_fn(cot.astype(y.dtype))
+
+                def skip_bwd():
+                    return (jax.tree.map(jnp.zeros_like, params_b),
+                            jnp.zeros_like(x_saved))
+
+                d_chunk_m, dx = lax.cond(active_b, run_bwd, skip_bwd)
+                if chunks == 1:
+                    d_stacked = jax.tree.map(
+                        lambda acc, g: acc + g.astype(jnp.float32)[None],
+                        carry['d_stacked'], d_chunk_m)
+                else:
+                    d_stacked = jax.tree.map(
+                        lambda acc, g: lax.dynamic_update_index_in_dim(
+                            acc,
+                            lax.dynamic_index_in_dim(acc, c_b, 0,
+                                                     keepdims=False)
+                            + g.astype(jnp.float32),
+                            c_b, 0),
+                        carry['d_stacked'], d_chunk_m)
+
+                # stage 0's chunk-0 input cotangent flows into the head
+                feed_b = lax.dynamic_index_in_dim(micro_in, m_b,
                                                   keepdims=False)
-                active_h = active_b & (stage == 0)
+                active_h = active_b & (stage == 0) & (c_b == 0)
 
                 def run_head_vjp():
                     _, head_vjp = jax.vjp(lambda p: head_fn(p, feed_b), reps)
@@ -305,6 +422,10 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
 
                 d_head_m = lax.cond(active_h, run_head_vjp,
                                     lambda: jax.tree.map(jnp.zeros_like, reps))
+                accumulate = lambda acc_tree, grad_tree, condition: jax.tree.map(
+                    lambda acc, g: acc + jnp.where(condition,
+                                                   g.astype(jnp.float32), 0),
+                    acc_tree, grad_tree)
                 d_reps = accumulate(
                     accumulate(carry['d_reps'],
                                jax.tree.map(lambda g: g * weight, d_tail_m),
@@ -321,20 +442,25 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 carry, _ = lax.scan(round_body, carry, jnp.arange(rounds))
             else:
                 # degenerate single stage: plain microbatch loop (head must
-                # sit INSIDE the objective so embedding grads flow)
+                # sit INSIDE the objective so embedding grads flow); the
+                # chunk dim flattens back to the layer-major stack
+                flat = jax.tree.map(
+                    lambda leaf: leaf.reshape((-1,) + leaf.shape[2:]), stacked)
+
                 def single(carry, m):
                     tgt = micro_tgt[m]
                     weight = (jnp.float32(weight_fn(tgt)) if weight_fn
                               else jnp.float32(1.0))
 
-                    def objective(reps, stacked):
+                    def objective(reps, flat):
                         x = head_fn(reps, micro_in[m])
-                        return weight * tail_fn(reps, stage_body(stacked, x),
+                        return weight * tail_fn(reps, stage_body(flat, x),
                                                 tgt)
                     loss_m, (d_r, d_s) = jax.value_and_grad(
-                        objective, argnums=(0, 1))(reps, stacked)
+                        objective, argnums=(0, 1))(reps, flat)
                     add_f32 = lambda acc_tree, grad_tree: jax.tree.map(
-                        lambda acc, g: acc + g.astype(jnp.float32),
+                        lambda acc, g: acc + g.astype(jnp.float32).reshape(
+                            acc.shape),
                         acc_tree, grad_tree)
                     return dict(
                         carry,
@@ -356,9 +482,10 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                               / total).astype(p.dtype),
                 carry['d_reps'], reps)
             d_stacked = jax.tree.map(
-                lambda g, p: ((lax.psum(g, batch_reduce) if batch_reduce
-                               else g) / total).astype(p.dtype),
-                carry['d_stacked'], stacked)
+                lambda g, p: (
+                    (lax.psum(g, batch_reduce) if batch_reduce else g)
+                    / total).astype(p.dtype).reshape(p.shape),
+                carry['d_stacked'], stacked_in)
             return loss, (d_reps, d_stacked)
 
         return run(replicated_params, stacked_params, inputs, targets)
@@ -367,9 +494,16 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
 
 
 def PipelineParallel(stacked_prefix: str = r'(^|/)h/', extra_rules=(),
-                     fsdp: bool = False, fsdp_min_size: int = 4096) -> ShardingPolicy:
+                     fsdp: bool = False, fsdp_min_size: int = 4096,
+                     interleave: int = 1) -> ShardingPolicy:
     """Sharding policy for pipelined models: leaves under ``stacked_prefix``
     (the stacked layer collection) shard their leading ``layers`` dimension
-    over ``stage``; everything else follows ``extra_rules`` / FSDP."""
-    rules = ((stacked_prefix, P(STAGE)),) + tuple(extra_rules)
+    over ``stage``; everything else follows ``extra_rules`` / FSDP.
+
+    ``interleave > 1`` matches :func:`pipeline_train`'s chunk-major layout
+    (leaves ``[interleave, layers/interleave, ...]``): the *second* dim
+    shards over ``stage``, so each device holds its ``interleave``
+    non-contiguous chunks without per-step resharding."""
+    spec = P(STAGE) if interleave <= 1 else P(None, STAGE)
+    rules = ((stacked_prefix, spec),) + tuple(extra_rules)
     return ShardingPolicy(rules=rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size)

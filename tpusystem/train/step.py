@@ -181,7 +181,8 @@ def build_1f1b_train_step(model, criterion: Criterion, optimizer,
 
     train = pipeline_train(model._embed, model._block_fn(), tail_fn,
                            model.mesh, microbatches=model.microbatches,
-                           weight_fn=getattr(criterion, 'weight', None))
+                           weight_fn=getattr(criterion, 'weight', None),
+                           interleave=getattr(model, 'interleave', 1))
 
     stacked_key = getattr(model, 'stacked_key', 'h')
 
