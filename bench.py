@@ -49,10 +49,13 @@ def main() -> None:
     # - Pallas flash attention for the single-chip run (1024/1024 tiles);
     # - fused chunked LM loss (return_features): the [B*S, vocab] f32 logits
     #   tensor is never materialized (~5% MFU, and unlocks batch >= 32);
-    # - 60 steps per jit call (lax.fori_loop): per-dispatch overhead through
-    #   the tunneled-TPU relay is ~7 ms (~5% of a 145 ms step) and the final
+    # - 90 steps per jit call (lax.fori_loop): per-dispatch overhead through
+    #   the tunneled-TPU relay is ~7 ms (~5% of a 135 ms step) and the final
     #   host sync costs another dispatch — amortized across the loop
-    #   (measured: 10 steps 0.498, 30 steps 0.515, 60 steps 0.519).
+    #   (measured r2: 10 steps 0.498, 30 0.515, 60 0.519; r3: 90 edges 60
+    #   by ~0.3% and 120 is flat). Round 3 also keeps the flash kernels
+    #   seedless at dropout=0 (the in-kernel dropout path wires its seed
+    #   input only when active — a persistent SMEM arg cost ~0.5%).
     module = GPT2(dropout=0.0, attention='flash', vocab_size=50304,
                   return_features=True)
     optimizer = AdamW(lr=3e-4, grad_clip=1.0)
@@ -64,7 +67,7 @@ def main() -> None:
     step = build_train_step(flax_apply(module), ChunkedNextTokenLoss(chunks=8),
                             optimizer, jit=False)
 
-    steps = 60
+    steps = 90
 
     @partial(jax.jit, donate_argnums=0)   # in-place param/slot updates in HBM
     def run(state, tokens):

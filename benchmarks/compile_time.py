@@ -1,0 +1,51 @@
+"""Compile time vs depth: unrolled layer loop vs scan_layers (stacked
+block params + lax.scan) on the Llama family — BASELINE.md scan-layers
+numbers.
+
+Measures lower+compile wall seconds of the full fwd+bwd train step on
+ABSTRACT inputs (`jax.eval_shape` state, `.lower(...).compile()`), so no
+parameter memory is materialized and the 8B-scale shape compiles on the
+host. XLA:CPU and XLA:TPU both scale with HLO size, which is what the
+unrolled loop inflates linearly in depth.
+"""
+import sys, time, json, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+from tpusystem.parallel import force_host_platform
+force_host_platform(1)
+
+import jax, jax.numpy as jnp
+
+from tpusystem.models import Llama
+from tpusystem.train import (AdamW, ChunkedNextTokenLoss, build_train_step,
+                             flax_apply, init_state)
+
+
+def compile_seconds(scan: bool, layers: int, dim=2048, ffn=7168, heads=16,
+                    kv_heads=8, vocab=32000, seq=1024, batch=2):
+    module = Llama(vocab_size=vocab, layers=layers, dim=dim, heads=heads,
+                   kv_heads=kv_heads, ffn_dim=ffn, max_seq=seq, remat=True,
+                   return_features=True, scan_layers=scan)
+    optimizer = AdamW(lr=1e-4)
+    tokens = jnp.zeros((batch, seq), jnp.int32)
+    state = jax.eval_shape(
+        lambda: init_state(module, optimizer, tokens[:1, :8]))
+    step = build_train_step(flax_apply(module),
+                            ChunkedNextTokenLoss(chunks=4, tied=False),
+                            optimizer, jit=False)
+    start = time.perf_counter()
+    lowered = jax.jit(step, donate_argnums=0).lower(state, tokens, tokens)
+    lower_s = time.perf_counter() - start
+    start = time.perf_counter()
+    lowered.compile()
+    compile_s = time.perf_counter() - start
+    print(json.dumps({'scan_layers': scan, 'layers': layers,
+                      'lower_s': round(lower_s, 1),
+                      'compile_s': round(compile_s, 1)}))
+    return compile_s
+
+
+for layers in (8, 16, 32):
+    unrolled = compile_seconds(False, layers)
+    scanned = compile_seconds(True, layers)
+    print(f'layers={layers}: unrolled {unrolled:.1f}s, '
+          f'scanned {scanned:.1f}s ({unrolled / scanned:.1f}x)')

@@ -1,0 +1,70 @@
+"""Headline-recipe sweep: GPT-2 125M train MFU variants on one chip.
+
+Same methodology as bench.py (donated fori_loop, materialized completion);
+each variant prints one JSON line. Used to pick the recipe bench.py pins.
+"""
+import sys, time, json, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+from functools import partial
+
+import jax, jax.numpy as jnp, numpy as np
+
+from bench import peak_flops
+from tpusystem.models import GPT2
+from tpusystem.train import (AdamW, ChunkedNextTokenLoss, build_train_step,
+                             flax_apply, init_state)
+
+
+def variant(tag, batch=16, seq=1024, chunks=8, steps=60, **model_overrides):
+    config = dict(dropout=0.0, attention='flash', vocab_size=50304,
+                  return_features=True)
+    config.update(model_overrides)
+    module = GPT2(**config)
+    optimizer = AdamW(lr=3e-4, grad_clip=1.0)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 50257, (batch, seq)), jnp.int32)
+    state = init_state(module, optimizer, tokens[:1, :8])
+    params_count = sum(leaf.size for leaf in jax.tree.leaves(state.params))
+    step = build_train_step(flax_apply(module),
+                            ChunkedNextTokenLoss(chunks=chunks),
+                            optimizer, jit=False)
+
+    @partial(jax.jit, donate_argnums=0)
+    def run(state, tokens):
+        return jax.lax.fori_loop(
+            0, steps, lambda i, st: step(st, tokens, tokens)[0], state)
+
+    state = run(state, tokens)
+    float(jax.tree.leaves(state.params)[0].sum())
+    start = time.perf_counter()
+    state = run(state, tokens)
+    float(jax.tree.leaves(state.params)[0].sum())
+    elapsed = time.perf_counter() - start
+
+    head_dim = module.dim // module.heads
+    attention_flops = (12 * module.layers * module.heads * seq * seq
+                       * head_dim * batch)
+    step_flops = 6 * params_count * batch * seq + attention_flops
+    mfu = step_flops * steps / elapsed / peak_flops(jax.devices()[0])
+    print(json.dumps({'variant': tag, 'mfu': round(mfu, 4),
+                      'ms_per_step': round(elapsed / steps * 1e3, 1)}))
+    return mfu
+
+
+def safe(tag, **kw):
+    try:
+        variant(tag, **kw)
+    except Exception as error:
+        print(json.dumps({'variant': tag, 'error': str(error)[:120]}))
+
+
+if __name__ == '__main__':
+    safe('baseline b16 c8 s60')
+    safe('repeat   b16 c8 s60')
+    safe('batch 24', batch=24)
+    safe('chunks 4', chunks=4)
+    safe('steps 90', steps=90)
+    # scan_layers: the relay's AOT compile helper 500s on the scan+pallas
+    # composition (runtime path works on CPU; compile-time win measured in
+    # compile_time.py) — keep it out of the default sweep
+    safe('steps 120', steps=120)

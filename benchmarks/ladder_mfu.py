@@ -1,0 +1,68 @@
+"""MFU for workload-ladder rows 2 (classifier) and 3 (ResNet-50) — the
+same unit as the ladder-4 headline (`bench.py`), same anti-hoisting
+methodology (steps chained through the carried TrainState inside one jit,
+completion forced by materializing a value).
+
+FLOPs per step come from XLA's own cost model on the compiled single-step
+program (`compile().cost_analysis()['flops']`): it counts the executed
+fwd+bwd+optimizer HLO, so the number is an *executed*-FLOPs utilization —
+marginally above a hand-counted model-FLOPs MFU (optimizer/elementwise
+included), stated as such in BASELINE.md.
+"""
+import sys, time, json, pathlib
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
+from functools import partial
+
+import jax, jax.numpy as jnp, numpy as np
+
+from bench import peak_flops
+from tpusystem.models import MLP, ResNet
+from tpusystem.train import (AdamW, CrossEntropyLoss, build_train_step,
+                             flax_apply, init_state)
+
+
+def measure(tag, module, inputs, targets, steps):
+    optimizer = AdamW(lr=1e-3)
+    state = init_state(module, optimizer, inputs[:1])
+    step = build_train_step(flax_apply(module), CrossEntropyLoss(),
+                            optimizer, jit=False)
+
+    single = jax.jit(lambda st: step(st, inputs, targets)[0])
+    flops = single.lower(state).compile().cost_analysis().get('flops', 0.0)
+
+    @partial(jax.jit, donate_argnums=0)
+    def run(state):
+        return jax.lax.fori_loop(
+            0, steps, lambda i, st: step(st, inputs, targets)[0], state)
+
+    state = run(state)
+    float(jax.tree.leaves(state.params)[0].sum())     # force completion
+    start = time.perf_counter()
+    state = run(state)
+    float(jax.tree.leaves(state.params)[0].sum())
+    elapsed = time.perf_counter() - start
+
+    steps_per_sec = steps / elapsed
+    peak = peak_flops(jax.devices()[0])
+    result = {
+        'workload': tag, 'steps_per_sec': round(steps_per_sec, 2),
+        'flops_per_step': float(flops),
+        'examples_per_sec': round(steps_per_sec * inputs.shape[0], 1),
+    }
+    if peak:
+        result['mfu'] = round(flops * steps_per_sec / peak, 4)
+    print(json.dumps(result))
+
+
+rng = np.random.default_rng(0)
+
+# ladder row 2: the tinysys-equivalent MNIST classifier (MLP 256/128)
+images = jnp.asarray(rng.normal(size=(64, 28, 28)), jnp.float32)
+labels = jnp.asarray(rng.integers(0, 10, (64,)), jnp.int32)
+measure('classifier_mlp_bs64', MLP(features=(256, 128), classes=10),
+        images, labels, steps=200)
+
+# ladder row 3: ResNet-50 at 224^2, bf16 NHWC, bs 64
+images = jnp.asarray(rng.normal(size=(64, 224, 224, 3)), jnp.bfloat16)
+labels = jnp.asarray(rng.integers(0, 1000, (64,)), jnp.int32)
+measure('resnet50_224_bs64', ResNet(), images, labels, steps=30)

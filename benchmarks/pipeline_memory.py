@@ -1,5 +1,5 @@
-"""Compiled peak temp memory: GPipe-autodiff vs the 1F1B schedule
-(virtual 4-stage CPU mesh, 16 microbatches) — BASELINE.md round-2 numbers.
+"""Compiled peak temp memory + schedule accounting: GPipe-autodiff vs 1F1B
+vs interleaved 1F1B (virtual 4-stage CPU mesh) — BASELINE.md numbers.
 """
 import sys, pathlib; sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
 import jax, jax.numpy as jnp, numpy as np
@@ -10,18 +10,38 @@ from tpusystem.parallel import MeshSpec
 from tpusystem.train import (NextTokenLoss, SGD, build_1f1b_train_step,
                              build_train_step, flax_apply, init_state)
 
-M = 16
-mesh = MeshSpec(stage=4).build()
-model = GPT2Pipelined(vocab_size=256, layers=4, dim=256, heads=4,
-                      max_seq=512, dtype='float32', microbatches=M, mesh=mesh)
+M, S, LAYERS = 16, 4, 8
+mesh = MeshSpec(stage=S).build()
 tokens = jnp.zeros((M, 512), jnp.int32)
-state = init_state(model, SGD(lr=0.1), tokens[:1], rng=0)
 
-def report(tag, step_fn):
+def report(tag, interleave, gpipe=False):
+    model = GPT2Pipelined(vocab_size=256, layers=LAYERS, dim=256, heads=4,
+                          max_seq=512, dtype='float32', microbatches=M,
+                          mesh=mesh, interleave=interleave)
+    state = init_state(model, SGD(lr=0.1), tokens[:1], rng=0)
+    step_fn = (build_train_step(flax_apply(model), NextTokenLoss(),
+                                SGD(lr=0.1), jit=False) if gpipe else
+               build_1f1b_train_step(model, NextTokenLoss(), SGD(lr=0.1),
+                                     jit=False))
     lowered = jax.jit(step_fn, donate_argnums=0).lower(state, tokens, tokens)
     mem = lowered.compile().memory_analysis()
     print(tag, 'temp MB:', round(mem.temp_size_in_bytes / 2**20, 1),
           'total MB:', round((mem.temp_size_in_bytes + mem.argument_size_in_bytes) / 2**20, 1))
 
-report('gpipe+autodiff', build_train_step(flax_apply(model), NextTokenLoss(), SGD(lr=0.1), jit=False))
-report('1f1b          ', build_1f1b_train_step(model, NextTokenLoss(), SGD(lr=0.1), jit=False))
+report('gpipe+autodiff     ', 1, gpipe=True)
+report('1f1b               ', 1)
+report('1f1b interleave=2  ', 2)
+
+# schedule accounting (per device, one step): busy chunk-units vs total
+# tick capacity. A chunk-unit for interleave=v is 1/v of a stage-unit, so
+# idle time is comparable across rows after dividing by v.
+print('\nschedule: ticks x unit-cost, idle fraction of the fwd slot')
+for v in (1, 2, 4):
+    if LAYERS % (v * S):
+        continue
+    rounds = v * M + v * S + S - 2
+    busy = v * M
+    print(f'interleave={v}: {rounds} ticks of 1/{v} stage-unit, '
+          f'fwd-slot idle {rounds - busy} chunk-ticks '
+          f'= {(rounds - busy) / v:.1f} stage-equivalents '
+          f'(bubble fraction {(rounds - busy) / rounds:.2%})')

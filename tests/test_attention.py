@@ -152,6 +152,92 @@ def test_gpt2_ring_attention_long_context_trains():
     np.testing.assert_allclose(losses(dense, False), losses(ringed, True), rtol=2e-4)
 
 
+def _reference_keep_mask(seed, bh, seq_q, seq_kv, dropout):
+    """The kernel's positional hash, recomputed outside the kernel."""
+    from tpusystem.ops.pallas.flash import _keep_mask
+    masks = [_keep_mask(jnp.int32(seed), jnp.int32(row), jnp.int32(0),
+                        jnp.int32(0), seq_q, seq_kv, dropout)
+             for row in range(bh)]
+    return jnp.stack(masks)                      # [bh, seq_q, seq_kv]
+
+
+def test_flash_dropout_matches_masked_reference():
+    """In-kernel dropout == plain-JAX attention with the SAME positional
+    mask: exact forward and gradient parity (the mask is a pure hash of
+    positions, so the reference regenerates it outside the kernel)."""
+    rng = np.random.default_rng(21)
+    batch, seq, heads, dim, p = 2, 64, 2, 16, 0.3
+    q, k, v = (jnp.asarray(rng.normal(size=(batch, seq, heads, dim)),
+                           jnp.float32) for _ in range(3))
+    key = jax.random.PRNGKey(5)
+    seed = int(jax.random.randint(key, (1,), 0, jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)[0])
+    keep = _reference_keep_mask(seed, batch * heads, seq, seq, p)
+    keep = keep.reshape(batch, heads, seq, seq)
+
+    def reference(q, k, v):
+        scores = jnp.einsum('bqhd,bkhd->bhqk', q, k) * dim ** -0.5
+        scores = jnp.where(np.tril(np.ones((seq, seq), bool)), scores, -1e30)
+        weights = jax.nn.softmax(scores, axis=-1)
+        weights = jnp.where(keep, weights / (1 - p), 0.0)
+        return jnp.einsum('bhqk,bkhd->bqhd', weights, v)
+
+    def kernelized(q, k, v):
+        return flash_attention(q, k, v, causal=True, block_q=32, block_kv=32,
+                               interpret=True, dropout=p, dropout_rng=key)
+
+    np.testing.assert_allclose(np.asarray(kernelized(q, k, v)),
+                               np.asarray(reference(q, k, v)), atol=2e-5)
+
+    loss = lambda fn: lambda q, k, v: jnp.mean(fn(q, k, v) ** 2)
+    got = jax.grad(loss(kernelized), argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss(reference), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-5)
+
+
+def test_flash_dropout_keep_rate_and_determinism():
+    """Statistical semantics: with uniform attention and all-ones values,
+    each output element reads off its row's keep count — the measured keep
+    rate matches 1 - p and survivors are scaled by 1/(1-p). Same key =>
+    identical masks; different key => different."""
+    p, seq, dim = 0.25, 128, 16
+    q = jnp.zeros((1, seq, 1, dim), jnp.float32)       # uniform probs
+    v = jnp.ones((1, seq, 1, dim), jnp.float32)
+    run = lambda key: flash_attention(
+        q, q, v, causal=False, block_q=64, block_kv=64, interpret=True,
+        dropout=p, dropout_rng=key)
+    out = np.asarray(run(jax.random.PRNGKey(0)))[0, :, 0, 0]
+    keep_rate = out * (1 - p)                           # count / seq
+    assert abs(keep_rate.mean() - (1 - p)) < 3 * np.sqrt(p * (1 - p) / seq), (
+        keep_rate.mean())
+    assert keep_rate.std() > 0                          # a real mask, not a scale
+    again = np.asarray(run(jax.random.PRNGKey(0)))[0, :, 0, 0]
+    np.testing.assert_array_equal(out, again)
+    other = np.asarray(run(jax.random.PRNGKey(1)))[0, :, 0, 0]
+    assert not np.array_equal(out, other)
+    # dropout=0 path unchanged
+    clean = flash_attention(q, q, v, causal=False, block_q=64, block_kv=64,
+                            interpret=True)
+    np.testing.assert_allclose(np.asarray(clean)[0, :, 0, 0], 1.0, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_gpt2_flash_attention_dropout_trains():
+    """attention='flash' with dropout > 0 now trains (the regularization
+    caveat is gone): one step runs and the loss is finite."""
+    from tpusystem.models import gpt2_tiny
+    from tpusystem.train import AdamW, NextTokenLoss, build_train_step, flax_apply, init_state
+    module = gpt2_tiny(attention='flash', dropout=0.1, dtype='float32')
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 64)),
+                         jnp.int32)
+    optimizer = AdamW(lr=1e-3)
+    state = init_state(module, optimizer, tokens[:1])
+    step = build_train_step(flax_apply(module), NextTokenLoss(), optimizer)
+    state, (_, loss) = step(state, tokens, tokens)
+    assert np.isfinite(float(loss))
+
+
 def test_block_fitting_keeps_midsize_lengths_on_the_kernel():
     """Defaults that do not divide the sequence shrink to the largest
     lane-aligned divisor instead of silently dropping to the O(seq^2) XLA

@@ -31,8 +31,9 @@ class SelfAttention(nn.Module):
     variants (shard_map over the mesh's seq axis).
 
     ``attn_dropout=None`` (default) applies ``dropout`` to the attention
-    probabilities on the 'xla' kernel — the torch-reference behavior — and
-    0.0 on kernels that don't implement it; set it explicitly to override.
+    probabilities on the 'xla' and 'flash' kernels — the torch-reference
+    behavior ('flash' drops in-kernel via positional hash masks) — and 0.0
+    on the sequence-parallel kernels; set it explicitly to override.
     """
 
     heads: int
@@ -40,20 +41,21 @@ class SelfAttention(nn.Module):
     dtype: jnp.dtype
     kernel: str = 'xla'    # 'xla' | 'flash' (Pallas) | 'ring' | 'ulysses'
     mesh: object = None    # required for 'ring'/'ulysses' (seq-sharded)
-    attn_dropout: float | None = None  # None -> follow `dropout` ('xla' only)
+    attn_dropout: float | None = None  # None -> follow `dropout`
     decode: bool = False   # KV-cache incremental decoding (xla kernel only)
     max_seq: int = 1024    # cache capacity when decoding
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
         if self.attn_dropout is None:
-            attn_dropout = self.dropout if self.kernel == 'xla' else 0.0
+            attn_dropout = (self.dropout if self.kernel in ('xla', 'flash')
+                            else 0.0)
         else:
             attn_dropout = self.attn_dropout
-            if attn_dropout and self.kernel != 'xla':
+            if attn_dropout and self.kernel not in ('xla', 'flash'):
                 raise ValueError(
-                    "attention-probability dropout is only implemented on the "
-                    f"'xla' kernel, not {self.kernel!r}")
+                    "attention-probability dropout is only implemented on "
+                    f"the 'xla' and 'flash' kernels, not {self.kernel!r}")
         dim = hidden.shape[-1]
         head_dim = dim // self.heads
         qkv = nn.Dense(3 * dim, dtype=self.dtype, name='qkv')(hidden)
@@ -140,7 +142,8 @@ class GPT2(nn.Module):
     dtype: str = 'bfloat16'
     attention: str = 'xla'  # 'xla' (GSPMD-shardable) | 'flash' | 'ring' | 'ulysses'
     mesh: object = None  # mesh for ring/ulysses sequence parallelism
-    attn_dropout: float | None = None  # None -> follow `dropout` ('xla' only)
+    attn_dropout: float | None = None  # None -> follow `dropout` on the
+    # 'xla' and 'flash' kernels (flash drops in-kernel), 0 elsewhere
     remat: bool = False  # recompute each block's activations in backward
     scan_layers: bool = False  # one lax.scan over stacked block params
     # instead of `layers` unrolled copies: XLA compiles ONE block body, so
@@ -161,10 +164,12 @@ class GPT2(nn.Module):
     def __call__(self, tokens, train: bool = False):
         compute_dtype = jnp.dtype(self.dtype)
         if self.decode:
-            # absolute positions continue from the cache cursor
-            offset = self.variable('cache', 'position',
-                                   lambda: jnp.zeros((), jnp.int32))
-            positions = offset.value + jnp.arange(tokens.shape[-1])
+            # absolute positions continue from the per-row cache cursor
+            # ([batch] — speculative decoding rewinds rows independently)
+            offset = self.variable(
+                'cache', 'position',
+                lambda: jnp.zeros((tokens.shape[0],), jnp.int32))
+            positions = offset.value[:, None] + jnp.arange(tokens.shape[-1])
             if not self.is_initializing():
                 offset.value = offset.value + tokens.shape[-1]
         else:

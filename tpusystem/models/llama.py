@@ -30,21 +30,28 @@ from tpusystem.registry import register
 
 def rotary_embedding(positions: jax.Array, head_dim: int,
                      theta: float = 500_000.0) -> tuple[jax.Array, jax.Array]:
-    """(cos, sin) tables of shape [len, head_dim/2], float32."""
+    """(cos, sin) tables of shape [*positions.shape, head_dim/2], float32.
+
+    ``positions`` is ``[len]`` for training/prefill or ``[batch, len]``
+    when rows decode at independent cursors (speculative decoding)."""
     frequencies = 1.0 / theta ** (
         jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
-    angles = positions.astype(jnp.float32)[:, None] * frequencies[None, :]
+    angles = positions.astype(jnp.float32)[..., None] * frequencies
     return jnp.cos(angles), jnp.sin(angles)
 
 
 def apply_rotary(tensor: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     """Rotate [batch, len, heads, head_dim] pairs (x_even, x_odd) by the
-    position angle. Runs in float32, returns in the input dtype."""
+    position angle. Runs in float32, returns in the input dtype. Tables
+    are [len, head_dim/2] (shared across the batch) or
+    [batch, len, head_dim/2] (per-row positions)."""
     dtype = tensor.dtype
     paired = tensor.astype(jnp.float32).reshape(*tensor.shape[:-1], -1, 2)
     even, odd = paired[..., 0], paired[..., 1]
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
     rotated = jnp.stack(
         (even * cos - odd * sin, even * sin + odd * cos), axis=-1)
     return rotated.reshape(tensor.shape).astype(dtype)
@@ -112,13 +119,13 @@ class LlamaAttention(nn.Module):
         value = value.reshape(batch, length, self.kv_heads, head_dim)
 
         if self.decode:
-            # rotary runs at absolute positions: peek at the cache cursor
-            # (declared and advanced by cached_attention; absent on the
-            # prefill call, where the offset is 0)
+            # rotary runs at absolute positions: peek at the per-row cache
+            # cursor ([batch] — declared and advanced by cached_attention;
+            # absent on the prefill call, where every offset is 0)
             cursor = (self.get_variable('cache', 'index')
                       if self.has_variable('cache', 'index')
-                      else jnp.zeros((), jnp.int32))
-            positions = cursor + jnp.arange(length)
+                      else jnp.zeros((batch,), jnp.int32))
+            positions = cursor[:, None] + jnp.arange(length)
         else:
             positions = jnp.arange(length)
         cos, sin = rotary_embedding(positions, head_dim, self.rope_theta)

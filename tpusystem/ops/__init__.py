@@ -1,7 +1,8 @@
 from tpusystem.ops.attention import attend, causal_mask, dot_product_attention
 from tpusystem.ops.moe import MoEMLP, expert_capacity, moe_partition_rules, route_top_k
-from tpusystem.ops.ring import ring_attention, ring_self_attention, ulysses_attention
+from tpusystem.ops.ring import (ring_attention, ring_self_attention,
+                                ulysses_attention, zigzag_ring_attention)
 
 __all__ = ['attend', 'dot_product_attention', 'causal_mask', 'MoEMLP', 'route_top_k',
            'expert_capacity', 'moe_partition_rules', 'ring_attention',
-           'ring_self_attention', 'ulysses_attention']
+           'ring_self_attention', 'ulysses_attention', 'zigzag_ring_attention']

@@ -46,24 +46,28 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
     GQA-aware, optional probability dropout). ``'flash'`` is the Pallas
     O(seq)-memory kernel — single-shard when ``mesh`` is None, composed
     with DP/FSDP/TP via ``shard_map`` over the (data, fsdp) x model axes
-    when a mesh is passed. ``'ring'``/``'ulysses'`` are the
-    sequence-parallel variants (need ``mesh`` with a seq axis); they take
-    full-head tensors, so grouped KV is repeated up to the query head
-    count first.
+    when a mesh is passed; attention-probability dropout runs in-kernel
+    (positional hash masks regenerated in the backward).
+    ``'ring'``/``'ulysses'`` are the sequence-parallel variants (need
+    ``mesh`` with a seq axis); they take full-head tensors, so grouped KV
+    is repeated up to the query head count first; probability dropout is
+    not implemented there.
     """
     if kernel == 'xla':
         return dot_product_attention(query, key, value, causal=causal,
                                      dropout=dropout, dropout_rng=dropout_rng)
-    if dropout:
-        raise ValueError("attention-probability dropout is only implemented "
-                         f"on the 'xla' kernel, not {kernel!r}")
     if kernel == 'flash':  # flash broadcasts GQA heads itself
         from tpusystem.ops.pallas.flash import (flash_attention,
                                                 sharded_flash_attention)
         if mesh is not None:  # compose with DP/FSDP/TP via shard_map
             return sharded_flash_attention(query, key, value, mesh,
-                                           causal=causal)
-        return flash_attention(query, key, value, causal=causal)
+                                           causal=causal, dropout=dropout,
+                                           dropout_rng=dropout_rng)
+        return flash_attention(query, key, value, causal=causal,
+                               dropout=dropout, dropout_rng=dropout_rng)
+    if dropout:
+        raise ValueError("attention-probability dropout is only implemented "
+                         f"on the 'xla' and 'flash' kernels, not {kernel!r}")
     if kernel in ('ring', 'ulysses'):
         from tpusystem.ops.ring import ring_self_attention
         key, value = repeat_kv_heads(query, key, value)
@@ -91,8 +95,14 @@ def cached_attention(module, query, key, value, max_seq: int):
     Capacity contract: the caller keeps cumulative tokens within
     ``max_seq`` (:func:`tpusystem.train.generate` enforces it up front).
     Past capacity the cursor is a traced value, so no in-program error is
-    possible — writes would clamp and attention would read clobbered
-    positions.
+    possible — out-of-bounds scatter rows are silently dropped (the new
+    K/V is never written and attention reads stale/zero positions).
+
+    The cursor (``index``) is **per-row** — ``[batch]`` int32 — so rows
+    may sit at different depths: speculative decoding advances each
+    sequence by its own acceptance count instead of the batch minimum.
+    Ordinary decode keeps every row equal; the row-indexed cache writes
+    and masks then coincide with the single-cursor formulation.
     """
     batch, length, kv_heads, head_dim = key.shape
     if length > max_seq:
@@ -113,15 +123,16 @@ def cached_attention(module, query, key, value, max_seq: int):
     cache_value = module.variable('cache', 'value', jnp.zeros, cache_shape,
                                   value.dtype)
     index = module.variable('cache', 'index',
-                            lambda: jnp.zeros((), jnp.int32))
+                            lambda: jnp.zeros((batch,), jnp.int32))
     if module.is_initializing():
         return dot_product_attention(query, key, value, causal=True)
-    cursor = index.value
-    cache_key.value = jax.lax.dynamic_update_slice(
-        cache_key.value, key.astype(cache_key.value.dtype), (0, cursor, 0, 0))
-    cache_value.value = jax.lax.dynamic_update_slice(
-        cache_value.value, value.astype(cache_value.value.dtype),
-        (0, cursor, 0, 0))
+    cursor = index.value                                    # [batch]
+    rows = jnp.arange(batch)[:, None]
+    positions = cursor[:, None] + jnp.arange(length)[None, :]   # [B, L]
+    cache_key.value = cache_key.value.at[rows, positions].set(
+        key.astype(cache_key.value.dtype))
+    cache_value.value = cache_value.value.at[rows, positions].set(
+        value.astype(cache_value.value.dtype))
     index.value = cursor + length
     if prefill:
         # Long prompts route through the flash kernel: einsum attention
@@ -134,11 +145,12 @@ def cached_attention(module, query, key, value, max_seq: int):
             from tpusystem.ops.pallas.flash import flash_attention
             return flash_attention(query, key, value, causal=True)
         return dot_product_attention(query, key, value, causal=True)
-    # attend causally over the filled prefix: key position <= cursor + offset
-    mask = (jnp.arange(max_seq)[None, :]
-            <= cursor + jnp.arange(length)[:, None])
+    # attend causally over the filled prefix, per row:
+    # key position <= row cursor + query offset
+    mask = (jnp.arange(max_seq)[None, None, :]
+            <= positions[:, :, None])                      # [B, L, S]
     return dot_product_attention(query, cache_key.value, cache_value.value,
-                                 causal=False, mask=mask)
+                                 causal=False, mask=mask[:, None])
 
 
 def dot_product_attention(query, key, value, *, causal: bool = True,

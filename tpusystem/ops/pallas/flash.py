@@ -35,6 +35,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -70,11 +71,39 @@ def _visible(causal: bool, q_idx, kv_idx, block_q: int, block_kv: int):
     return (not causal) or (q_idx * block_q + block_q - 1 >= kv_idx * block_kv)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _keep_mask(seed, head_row, q_idx, kv_idx, block_q: int, block_kv: int,
+               dropout: float):
+    """Per-tile Bernoulli(1 - dropout) keep mask, reproducible by position.
+
+    A counter-style hash (xorshift-multiply mixing) of the *global*
+    (head-row, query-position, key-position) triple plus the step seed —
+    not the sequential hardware PRNG — so the forward, dq, and dkv kernels
+    regenerate byte-identical masks even though their grids sweep the
+    tiles in different orders, and interpret mode (CPU tests) produces the
+    same masks as the TPU lowering.
+    """
+    shape = (block_q, block_kv)
+    rows = (jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+            + (q_idx * block_q).astype(jnp.uint32))
+    cols = (jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+            + (kv_idx * block_kv).astype(jnp.uint32))
+    x = rows * jnp.uint32(0x9E3779B1) ^ cols * jnp.uint32(0x85EBCA77)
+    x = x + seed.astype(jnp.uint32) + head_row.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return (x >> 8) < jnp.uint32(int(round((1.0 - dropout) * (1 << 24))))
+
+
+def _flash_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                       m_scr, l_scr, acc_scr,
                       *, scale: float, causal: bool,
-                      block_q: int, block_kv: int):
-    q_idx, kv_idx = pl.program_id(1), pl.program_id(2)
+                      block_q: int, block_kv: int, dropout: float):
+    # program_id must be read at the kernel top level (not inside pl.when
+    # bodies — interpret mode does not substitute it there)
+    head, q_idx, kv_idx = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     kv_steps = pl.num_programs(2)
 
     @pl.when(kv_idx == 0)
@@ -96,9 +125,18 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
         probs = jnp.exp(scores - m_new)                     # (block_q, block_kv)
         correction = jnp.exp(m_prev - m_new)                # (block_q, 1)
+        # the softmax denominator accumulates UNmasked probabilities —
+        # attention-probability dropout drops normalized weights, it does
+        # not renormalize over survivors (the 'xla' path's semantics)
         l_new = correction * l_scr[:, :1] + jnp.sum(probs, axis=1, keepdims=True)
+        if dropout:
+            keep = _keep_mask(seed_ref[0], head, q_idx, kv_idx,
+                              block_q, block_kv, dropout)
+            contrib = probs * keep
+        else:
+            contrib = probs
         acc_scr[...] = acc_scr[...] * correction + jax.lax.dot_general(
-            probs.astype(value.dtype), value, (((1,), (0,)), ((), ())),
+            contrib.astype(value.dtype), value, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -107,15 +145,19 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
     def _finish():
         l_final = l_scr[:, :1]
         safe_l = jnp.where(l_final == 0.0, 1.0, l_final)
-        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        out = acc_scr[...] / safe_l
+        if dropout:
+            out = out / (1.0 - dropout)       # inverted-dropout scaling
+        o_ref[0] = out.astype(o_ref.dtype)
         lse = m_scr[:, :1] + jnp.log(safe_l)                # (block_q, 1)
         lse_ref[0] = jnp.broadcast_to(lse, (lse.shape[0], STATS))
 
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+def _flash_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, dq_ref,
                      dq_scr, *, scale: float, causal: bool,
-                     block_q: int, block_kv: int):
-    q_idx, kv_idx = pl.program_id(1), pl.program_id(2)
+                     block_q: int, block_kv: int, dropout: float):
+    head, q_idx, kv_idx = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     kv_steps = pl.num_programs(2)
 
     @pl.when(kv_idx == 0)
@@ -133,6 +175,13 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dprobs = jax.lax.dot_general(
             grad_out, value, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout:
+            # d(out)/d(score): the kept-weight term carries the mask and
+            # the 1/(1-p) scale; the softmax-denominator term keeps the
+            # full (unmasked) probability — see the forward's l rule
+            keep = _keep_mask(seed_ref[0], head, q_idx, kv_idx,
+                              block_q, block_kv, dropout)
+            dprobs = keep * dprobs / (1.0 - dropout)
         dscores = probs * (dprobs - delta_ref[0, :, :1]) * scale
         dq_scr[...] += jax.lax.dot_general(
             dscores.astype(key.dtype), key, (((1,), (0,)), ((), ())),
@@ -143,15 +192,20 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, dk_scr, dv_scr,
+def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                      delta_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                       *, scale: float, causal: bool,
-                      block_q: int, block_kv: int, q_steps: int):
+                      block_q: int, block_kv: int, q_steps: int, group: int,
+                      dropout: float):
     # the innermost grid dim sweeps (group member, q block) pairs under
     # GQA: the q-block index for causal masking is its q_steps remainder,
     # and dk/dv accumulate across the whole sweep
     kv_idx, sweep = pl.program_id(1), pl.program_id(2)
     q_idx = sweep % q_steps
+    # the mask row is the QUERY head's bh row (the forward hashed with
+    # program_id(0) over B*Hq; this grid's dim 0 walks KV rows); read at
+    # top level — interpret mode does not substitute program_id in when-bodies
+    head_row = pl.program_id(0) * group + sweep // q_steps
 
     @pl.when(sweep == 0)
     def _init():
@@ -166,12 +220,19 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 q_idx=q_idx, kv_idx=kv_idx,
                                 block_q=block_q, block_kv=block_kv)
         probs = jnp.exp(scores - lse_ref[0, :, :1])           # (bq, bkv)
-        dv_scr[...] += jax.lax.dot_general(
-            probs.astype(grad_out.dtype), grad_out, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)               # (bkv, d)
         dprobs = jax.lax.dot_general(
             grad_out, value, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if dropout:
+            keep = _keep_mask(seed_ref[0], head_row, q_idx, kv_idx,
+                              block_q, block_kv, dropout)
+            kept = probs * keep / (1.0 - dropout)
+            dprobs = keep * dprobs / (1.0 - dropout)
+        else:
+            kept = probs
+        dv_scr[...] += jax.lax.dot_general(
+            kept.astype(grad_out.dtype), grad_out, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bkv, d)
         dscores = probs * (dprobs - delta_ref[0, :, :1]) * scale
         dk_scr[...] += jax.lax.dot_general(
             dscores.astype(query.dtype), query, (((0,), (0,)), ((), ())),
@@ -211,24 +272,28 @@ def _block_sizes(seq_q: int, seq_kv: int, block_q: int, block_kv: int):
     return block_q, block_kv
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
-               group=1):
+def _flash_fwd(q, k, v, seed, causal, scale, block_q, block_kv, interpret,
+               group=1, dropout=0.0):
     """q: [B*Hq, S, D]; k/v: [B*Hkv, S, D] with Hq = Hkv * group.
 
     GQA lives entirely in the index maps: query row ``i`` reads KV row
     ``i // group`` (b-major head layout makes that exact), so grouped KV
-    is never materialized at the query head count. Returns
+    is never materialized at the query head count. ``seed`` is a [1] int32
+    (SMEM) feeding the positional dropout hash. Returns
     (out, residuals)."""
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
     grid = (bh, seq_q // block_q, seq_kv // block_kv)
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv)
+        block_q=block_q, block_kv=block_kv, dropout=dropout)
+    # the seed input exists only on the dropout path, so the dropout=0
+    # program (the perf-critical one) is identical to a seedless build
+    seed_args, seed_specs, kernel = _seed_wiring(kernel, seed, dropout)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
             pl.BlockSpec((1, block_kv, head_dim),
                          lambda i, j, k_: (i // group, k_, 0)),
@@ -249,19 +314,30 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
             pltpu.VMEM((block_q, head_dim), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
-    return out, (q, k, v, out, lse)
+    )(*seed_args, q, k, v)
+    return out, (q, k, v, seed, out, lse)
+
+
+def _seed_wiring(kernel, seed, dropout):
+    """Seed input wiring: present only when dropout is active (the
+    dropout=0 kernels never read it, and omitting the argument keeps the
+    hot-path program identical to a seedless build). Returns
+    ``(extra_args, extra_in_specs, kernel)``."""
+    if dropout:
+        return (seed,), [pl.BlockSpec(memory_space=pltpu.SMEM)], kernel
+    return (), [], functools.partial(kernel, None)
 
 
 def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
-                    residuals, grad_out, grad_lse):
+                    dropout, residuals, grad_out, grad_lse):
     """Backward for :func:`_flash_lse`. ``grad_lse`` (bh, seq_q) is the
     cotangent of the logsumexp output (ring attention merges chunk results
     by lse, so gradient flows into it; plain ``flash_attention`` discards
     lse and its cotangent arrives as zeros); per-score gradient is
     p*(dprobs - (delta - dlse)), so it folds into the precomputed delta
-    term."""
-    q, k, v, out, lse = residuals
+    term. Under dropout the kernels regenerate the forward's positional
+    keep masks from the same seed."""
+    q, k, v, seed, out, lse = residuals
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
     delta = jnp.sum(grad_out.astype(jnp.float32) * out.astype(jnp.float32),
@@ -272,11 +348,12 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
 
     dq_kernel = functools.partial(
         _flash_dq_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv)
+        block_q=block_q, block_kv=block_kv, dropout=dropout)
+    seed_args, seed_specs, dq_kernel = _seed_wiring(dq_kernel, seed, dropout)
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, seq_q // block_q, seq_kv // block_kv),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, head_dim), lambda i, j, k_: (i, j, 0)),
             pl.BlockSpec((1, block_kv, head_dim),
                          lambda i, j, k_: (i // group, k_, 0)),
@@ -290,12 +367,14 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, grad_out, lse, delta)
+    )(*seed_args, q, k, v, grad_out, lse, delta)
 
     q_steps = seq_q // block_q
     dkv_kernel = functools.partial(
         _flash_dkv_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_kv=block_kv, q_steps=q_steps)
+        block_q=block_q, block_kv=block_kv, q_steps=q_steps, group=group,
+        dropout=dropout)
+    seed_args, seed_specs, dkv_kernel = _seed_wiring(dkv_kernel, seed, dropout)
     # grid dim 0 walks KV rows; the innermost dim sweeps every (group
     # member, q block) pair so one kv head's dk/dv accumulates over all
     # the query heads that shared it
@@ -303,7 +382,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bh // group, seq_kv // block_kv, q_steps * group),
-        in_specs=[
+        in_specs=seed_specs + [
             pl.BlockSpec((1, block_q, head_dim), row),
             pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
             pl.BlockSpec((1, block_kv, head_dim), lambda i, k_, j: (i, k_, 0)),
@@ -324,30 +403,31 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
             pltpu.VMEM((block_kv, head_dim), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, grad_out, lse, delta)
-    return dq, dk, dv
+    )(*seed_args, q, k, v, grad_out, lse, delta)
+    return dq, dk, dv, np.zeros(seed.shape, jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_lse(q, k, v, causal, scale, block_q, block_kv, interpret, group):
-    (out, lse), _ = _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv,
-                                   interpret, group)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, seed, causal, scale, block_q, block_kv, interpret,
+               group, dropout):
+    (out, lse), _ = _flash_lse_fwd(q, k, v, seed, causal, scale, block_q,
+                                   block_kv, interpret, group, dropout)
     return out, lse
 
 
-def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_kv, interpret,
-                   group):
-    out, residuals = _flash_fwd(q, k, v, causal, scale, block_q, block_kv,
-                                interpret, group)
-    lse = residuals[4][..., 0]                                # (bh, seq_q)
+def _flash_lse_fwd(q, k, v, seed, causal, scale, block_q, block_kv, interpret,
+                   group, dropout):
+    out, residuals = _flash_fwd(q, k, v, seed, causal, scale, block_q,
+                                block_kv, interpret, group, dropout)
+    lse = residuals[5][..., 0]                                # (bh, seq_q)
     return (out, lse), residuals
 
 
 def _flash_lse_bwd(causal, scale, block_q, block_kv, interpret, group,
-                   residuals, grads):
+                   dropout, residuals, grads):
     grad_out, grad_lse = grads
     return _flash_bwd_impl(causal, scale, block_q, block_kv, interpret,
-                           group, residuals, grad_out, grad_lse)
+                           group, dropout, residuals, grad_out, grad_lse)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -356,7 +436,8 @@ _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 def flash_attention(query, key, value, *, causal: bool = True,
                     scale: float | None = None,
                     block_q: int = 1024, block_kv: int = 1024,
-                    interpret: bool | None = None):
+                    interpret: bool | None = None,
+                    dropout: float = 0.0, dropout_rng=None):
     """Flash attention over [batch, length, heads, head_dim] tensors.
 
     Drop-in for :func:`tpusystem.ops.attention.dot_product_attention`
@@ -367,20 +448,29 @@ def flash_attention(query, key, value, *, causal: bool = True,
     ``interpret=None`` auto-selects interpreter mode off-TPU so the same
     model code runs in CPU tests.
 
+    ``dropout > 0`` (with ``dropout_rng``) drops attention probabilities
+    in-kernel with the 'xla' path's semantics: normalized weights are
+    dropped (no renormalization over survivors) and survivors scale by
+    ``1/(1-p)``. Masks come from a positional counter hash seeded by
+    ``dropout_rng``, regenerated identically in the backward kernels —
+    nothing O(seq^2) is ever stored.
+
     Thin front of :func:`flash_attention_lse`: the discarded lse output
     costs nothing (the kernel computes it regardless) and its zero
     cotangent folds to a no-op in the shared backward.
     """
     out, _ = flash_attention_lse(query, key, value, causal=causal,
                                  scale=scale, block_q=block_q,
-                                 block_kv=block_kv, interpret=interpret)
+                                 block_kv=block_kv, interpret=interpret,
+                                 dropout=dropout, dropout_rng=dropout_rng)
     return out
 
 
 def flash_attention_lse(query, key, value, *, causal: bool = True,
                         scale: float | None = None,
                         block_q: int = 1024, block_kv: int = 1024,
-                        interpret: bool | None = None):
+                        interpret: bool | None = None,
+                        dropout: float = 0.0, dropout_rng=None):
     """Flash attention that also returns the softmax logsumexp.
 
     Returns ``(out [B,S,H,D], lse [B,S,H] float32)``. The lse output is what
@@ -390,9 +480,21 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
     both outputs — the lse cotangent folds into the backward kernels' delta
     term. Falls back to a differentiable XLA path (explicit scores +
     logsumexp) when no lane-aligned block divides the sequence.
+
+    ``dropout``/``dropout_rng``: in-kernel attention-probability dropout
+    (see :func:`flash_attention`). The lse output stays the FULL softmax
+    denominator (dropout does not renormalize), so blockwise merges are
+    unaffected.
     """
     if interpret is None:
         interpret = jax.default_backend() not in ('tpu', 'axon')
+    if dropout:
+        if dropout_rng is None:
+            raise ValueError('dropout > 0 needs a dropout_rng key')
+        seed = jax.random.randint(dropout_rng, (1,), 0, jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
 
     batch, seq_q, q_heads, head_dim = query.shape
     kv_heads = key.shape[2]
@@ -408,20 +510,24 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
     if sizes is None:
         from tpusystem.ops.attention import repeat_kv_heads
         key, value = repeat_kv_heads(query, key, value)
-        return _xla_attention_lse(query, key, value, causal=causal, scale=scale)
+        return _xla_attention_lse(query, key, value, causal=causal,
+                                  scale=scale, dropout=dropout,
+                                  dropout_rng=dropout_rng)
     block_q, block_kv = sizes
 
     def to_bh(tensor):  # [B,S,H,D] -> [B*H, S, D]
         return tensor.transpose(0, 2, 1, 3).reshape(-1, tensor.shape[1], head_dim)
 
-    out, lse = _flash_lse(to_bh(query), to_bh(key), to_bh(value),
-                          causal, scale, block_q, block_kv, interpret, group)
+    out, lse = _flash_lse(to_bh(query), to_bh(key), to_bh(value), seed,
+                          causal, scale, block_q, block_kv, interpret, group,
+                          float(dropout))
     out = out.reshape(batch, q_heads, seq_q, head_dim).transpose(0, 2, 1, 3)
     lse = lse.reshape(batch, q_heads, seq_q).transpose(0, 2, 1)
     return out, lse
 
 
-def _xla_attention_lse(query, key, value, *, causal: bool, scale: float):
+def _xla_attention_lse(query, key, value, *, causal: bool, scale: float,
+                       dropout: float = 0.0, dropout_rng=None):
     """Reference (out, lse) pair in plain XLA ops — the fallback for
     sequence lengths the kernel cannot tile, and the 'einsum' inner kernel
     of ring attention."""
@@ -434,12 +540,16 @@ def _xla_attention_lse(query, key, value, *, causal: bool, scale: float):
                            scores, NEG_INF)
     lse = jax.scipy.special.logsumexp(scores, axis=-1)        # [B,H,Q]
     weights = jnp.exp(scores - lse[..., None])
+    if dropout and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout, weights.shape)
+        weights = jnp.where(keep, weights / (1.0 - dropout), 0.0)
     out = jnp.einsum('bhqk,bkhd->bqhd', weights.astype(value.dtype), value)
     return out, lse.transpose(0, 2, 1)                        # lse -> [B,S,H]
 
 
 def sharded_flash_attention(query, key, value, mesh, *, causal: bool = True,
-                            scale: float | None = None):
+                            scale: float | None = None,
+                            dropout: float = 0.0, dropout_rng=None):
     """Flash attention composed with GSPMD policies via ``shard_map``.
 
     Attention is embarrassingly parallel over batch x heads: batch shards
@@ -476,6 +586,14 @@ def sharded_flash_attention(query, key, value, mesh, *, causal: bool = True,
     @functools.partial(jax.shard_map, mesh=mesh, check_vma=False,
                        in_specs=(spec, spec, spec), out_specs=spec)
     def mapped(q, k, v):
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        rng = dropout_rng
+        if dropout and rng is not None:
+            # decorrelate the dropout masks across shards (the positional
+            # hash would otherwise repeat per local batch/head index)
+            for axis in (DATA, FSDP, MODEL):
+                if shape.get(axis, 1) > 1:
+                    rng = jax.random.fold_in(rng, jax.lax.axis_index(axis))
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               dropout=dropout, dropout_rng=rng)
 
     return mapped(query, key, value)

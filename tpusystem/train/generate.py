@@ -94,9 +94,11 @@ def speculative_generate(module, params, prompt, *, steps: int,
       **distribution** is exactly the target's sampling distribution.
 
     Both KV caches rewind their cursors to the accepted prefix each round.
-    Batched prompts advance by the *minimum* acceptance across the batch
-    (per-element cursors would need per-row cache writes), so speedup is
-    largest at small batch.
+    Cache cursors are **per-row** (the caches write and mask at each row's
+    own depth), so every sequence advances by its own acceptance count —
+    one slow row no longer drags the whole batch to its acceptance, and
+    the speedup survives batching. Rows that reach ``steps`` idle (their
+    cursor and output stop advancing) until the slowest row finishes.
 
     Returns int32 ``[batch, prompt_len + steps]`` like :func:`generate`.
     """
@@ -164,11 +166,12 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
         out = out.at[:, 0].set(token)
 
         def cond(carry):
-            return carry[0] < steps
+            return jnp.min(carry[0]) < steps
 
         def body(carry):
             produced, cursor, token, out, rng, tcache, dcache = carry
             rng, draft_rng, accept_rng, fix_rng = jax.random.split(rng, 4)
+            done = produced >= steps                       # [B] idle rows
 
             def draft_step(state, key):
                 cache, tok = state
@@ -195,18 +198,19 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
 
             if temperature == 0.0:
                 # acceptance = exact match against the target's greedy
-                # choices; correction = the target's own choice there
+                # choices; correction = the target's own choice there —
+                # all per row
                 candidates = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
                 matches = (drafts == candidates[:, :K]).astype(jnp.int32)
-                accepted = jnp.min(
-                    jnp.sum(jnp.cumprod(matches, axis=1), axis=1))
-                correction = jax.lax.dynamic_index_in_dim(
-                    candidates, accepted, axis=1, keepdims=False)
+                accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+                correction = jnp.take_along_axis(
+                    candidates, accepted[:, None], axis=1)[:, 0]
             else:
                 # rejection sampling: accept draft token d with probability
                 # min(1, p(d)/q(d)); the correction resamples from
-                # norm(max(0, p - q)) at the first rejection, or from p
-                # itself when every draft was accepted (q masked to 0)
+                # norm(max(0, p - q)) at each row's first rejection, or
+                # from p itself when every draft was accepted (q masked
+                # to 0 at index K)
                 p_dist = jax.nn.softmax(
                     vlogits.astype(jnp.float32) / temperature, axis=-1)
                 q_dist = jax.nn.softmax(
@@ -217,46 +221,46 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
                     q_dist, drafts[..., None], axis=-1)[..., 0]
                 uniforms = jax.random.uniform(accept_rng, (batch, K))
                 accepts = (uniforms * q_draft < p_draft).astype(jnp.int32)
-                per_row = jnp.sum(jnp.cumprod(accepts, axis=1), axis=1)
-                accepted = jnp.min(per_row)                       # batch min
-                p_at = jax.lax.dynamic_index_in_dim(
-                    p_dist, accepted, axis=1, keepdims=False)     # [B, V]
+                accepted = jnp.sum(jnp.cumprod(accepts, axis=1), axis=1)
+                p_at = jnp.take_along_axis(
+                    p_dist, accepted[:, None, None],
+                    axis=1)[:, 0]                          # [B, V]
                 q_padded = jnp.pad(q_dist, ((0, 0), (0, 1), (0, 0)))
-                q_at = jax.lax.dynamic_index_in_dim(
-                    q_padded, accepted, axis=1, keepdims=False)
+                q_at = jnp.take_along_axis(
+                    q_padded, accepted[:, None, None], axis=1)[:, 0]
                 residual = jnp.maximum(p_at - q_at, 0.0)
                 # float rounding can zero the residual; fall back to p
                 degenerate = jnp.sum(residual, -1, keepdims=True) < 1e-9
                 residual = jnp.where(degenerate, p_at, residual)
-                resampled = jax.random.categorical(
+                correction = jax.random.categorical(
                     fix_rng, jnp.log(residual + 1e-20), axis=-1
                 ).astype(jnp.int32)
-                # rows that accepted MORE than the batch minimum keep their
-                # accepted draft at this position instead of resampling
-                row_accepted_here = (per_row > accepted) & (accepted < K)
-                padded_drafts = jnp.pad(drafts, ((0, 0), (0, 1)))
-                draft_here = jax.lax.dynamic_index_in_dim(
-                    padded_drafts, accepted, axis=1, keepdims=False)
-                correction = jnp.where(row_accepted_here, draft_here,
-                                       resampled)
 
-            # emit accepted drafts plus the per-row correction token
+            # emit each row's accepted drafts plus its correction token;
+            # idle rows write nowhere (their columns land out of bounds)
             positions = jnp.arange(K + 1)[None, :]
             emitted = jnp.where(
-                positions < accepted,
+                positions < accepted[:, None],
                 jnp.pad(drafts, ((0, 0), (0, 1))),
-                jnp.where(positions == accepted, correction[:, None], 0))
-            out = jax.lax.dynamic_update_slice(out, emitted, (0, produced))
+                jnp.where(positions == accepted[:, None],
+                          correction[:, None], 0))
+            columns = jnp.where(done[:, None], out.shape[1],
+                                produced[:, None] + positions)
+            out = out.at[jnp.arange(batch)[:, None], columns].set(
+                emitted, mode='drop')
 
-            produced = produced + accepted + 1
-            cursor = cursor + accepted + 1
-            token = jax.lax.dynamic_index_in_dim(
-                emitted, accepted, axis=1, keepdims=False)
+            advance = jnp.where(done, 0, accepted + 1)
+            produced = produced + advance
+            cursor = cursor + advance
+            token = jnp.where(
+                done, token,
+                jnp.take_along_axis(emitted, accepted[:, None], axis=1)[:, 0])
             return (produced, cursor, token, out, rng,
                     _rewind(tupdated['cache'], cursor),
                     _rewind(dcache, cursor))
 
-        carry = (jnp.int32(1), jnp.int32(prefix), token, out, rng,
+        carry = (jnp.full((batch,), 1, jnp.int32),
+                 jnp.full((batch,), prefix, jnp.int32), token, out, rng,
                  tstate['cache'], dstate['cache'])
         _, _, _, out, _, _, _ = jax.lax.while_loop(cond, body, carry)
         return jnp.concatenate([prompt, out[:, :steps]], axis=1)
