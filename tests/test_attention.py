@@ -425,6 +425,56 @@ def test_ring_variant_auto_upgrades_to_zigzag(qkv, monkeypatch):
 
 
 @pytest.mark.slow
+@pytest.mark.parametrize('variant', ['ring', 'ulysses'])
+def test_ring_gqa_keeps_kv_grouped(variant, monkeypatch):
+    """Grouped-query attention on the sequence-parallel paths: KV rotates
+    at its own head count (group-factor fewer ppermute bytes on the ring
+    variants), output matches the broadcast reference — fwd and grads."""
+    import tpusystem.ops.ring as ring_module
+    from tpusystem.ops.attention import repeat_kv_heads
+    rng = np.random.default_rng(17)
+    q = jnp.asarray(rng.normal(size=(2, 128, 8, 16)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 128, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 128, 2, 16)), jnp.float32)
+    mesh = MeshSpec(data=2, seq=4).build()
+
+    rotated_heads = []
+    real_permute = ring_module._ring_permute
+
+    def spying_permute(axis, ring):
+        permute = real_permute(axis, ring)
+        def wrapped(tensor):
+            rotated_heads.append(tensor.shape[2])
+            return permute(tensor)
+        return wrapped
+
+    monkeypatch.setattr(ring_module, '_ring_permute', spying_permute)
+
+    kk, vv = repeat_kv_heads(q, k, v)
+    reference = dot_product_attention(q, kk, vv, causal=True)
+    sharded = ring_self_attention(q, k, v, mesh, causal=True, variant=variant)
+    np.testing.assert_allclose(np.asarray(reference), np.asarray(sharded),
+                               atol=2e-5)
+    if variant == 'ring':   # zigzag path: rotating tensors carry 2 KV heads
+        assert rotated_heads and set(rotated_heads) == {2}, rotated_heads
+
+    def loss_single(q, k, v):
+        kk, vv = repeat_kv_heads(q, k, v)
+        return jnp.mean(dot_product_attention(q, kk, vv, causal=True) ** 2)
+
+    def loss_sharded(q, k, v):
+        return jnp.mean(ring_self_attention(q, k, v, mesh, causal=True,
+                                            variant=variant) ** 2)
+
+    grads_single = jax.grad(loss_single, argnums=(0, 1, 2))(q, k, v)
+    grads_sharded = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    for single, sharded in zip(grads_single, grads_sharded):
+        assert single.shape == sharded.shape
+        np.testing.assert_allclose(np.asarray(single), np.asarray(sharded),
+                                   atol=5e-5)
+
+
+@pytest.mark.slow
 def test_ring_einsum_inner_fallback_matches(qkv):
     """inner='einsum' (the XLA fallback path) stays at parity too."""
     q, k, v = qkv

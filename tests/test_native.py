@@ -21,7 +21,7 @@ def lib():
 
 
 def test_builds_and_reports_abi(lib):
-    assert lib.ts_abi_version() == 1
+    assert lib.ts_abi_version() == 2
     assert native.available()
 
 
@@ -168,3 +168,43 @@ class TestMemmapTokens:
         path, _ = corpus
         ds = MemmapTokens(path, sequence_length=64)
         assert getarguments(ds)['sequence_length'] == 64
+
+
+@pytest.mark.parametrize('dtype', [np.uint16, np.int32, np.float32])
+def test_gather_windows_matches_numpy(lib, dtype):
+    rng = np.random.default_rng(3)
+    corpus = rng.integers(0, 500, size=4096).astype(dtype)
+    starts = rng.integers(0, 4096 - 65, size=48)
+    window = 65
+    reference = corpus[starts[:, None] + np.arange(window)[None, :]]
+    np.testing.assert_array_equal(
+        native.gather_windows(corpus, starts, window), reference)
+
+
+def test_gather_windows_overlapping_and_from_memmap(lib, tmp_path):
+    corpus = np.arange(10_000, dtype=np.uint16)
+    path = tmp_path / 'corpus.bin'
+    corpus.tofile(path)
+    mapped = np.memmap(path, dtype=np.uint16, mode='r')
+    starts = np.arange(0, 9000, 7)          # overlapping windows
+    window = 129
+    reference = mapped[starts[:, None] + np.arange(window)[None, :]]
+    np.testing.assert_array_equal(
+        native.gather_windows(mapped, starts, window), reference)
+
+
+def test_gather_windows_falls_back_out_of_range(lib):
+    corpus = np.arange(100, dtype=np.int32)
+    with pytest.raises(IndexError):
+        native.gather_windows(corpus, np.array([90]), 20)  # numpy semantics
+
+
+def test_memmap_tokens_batched_windows(tmp_path):
+    from tpusystem.data import MemmapTokens
+    corpus = np.arange(5000, dtype=np.uint16)
+    path = tmp_path / 'tokens.bin'
+    corpus.tofile(path)
+    data = MemmapTokens(path, sequence_length=64)
+    batch = data[np.asarray([0, 3, 7])][0]
+    assert batch.shape == (3, 65) and batch.dtype == np.int32
+    np.testing.assert_array_equal(batch[1], np.arange(3 * 64, 3 * 64 + 65))

@@ -95,9 +95,14 @@ class MemmapTokens:
     def __getitem__(self, index) -> tuple:
         window = self.sequence_length + 1
         if isinstance(index, np.ndarray):
-            starts = (index.astype(np.int64) * self.stride)[:, None]
-            positions = starts + np.arange(window)[None, :]
-            return (self._tokens[positions].astype(np.int32),)
+            # batched window gather: native per-window memcpy straight from
+            # the page cache (multithreaded, GIL released) when the
+            # toolchain built batcher.cpp, numpy fancy indexing otherwise —
+            # bit-identical either way
+            from tpusystem.data import native
+            starts = index.astype(np.int64) * self.stride
+            rows = native.gather_windows(self._tokens, starts, window)
+            return (rows.astype(np.int32),)
         start = int(index) * self.stride
         return (self._tokens[start:start + window].astype(np.int32),)
 

@@ -24,7 +24,7 @@ import tempfile
 import numpy as np
 
 _SOURCE = pathlib.Path(__file__).with_name('batcher.cpp')
-_ABI = 1
+_ABI = 2
 _lib: ctypes.CDLL | None | bool = False   # False = not tried yet
 
 
@@ -65,6 +65,10 @@ def _build() -> ctypes.CDLL | None:
             return None
         lib.ts_gather_rows.restype = None
         lib.ts_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
+        lib.ts_gather_windows.restype = None
+        lib.ts_gather_windows.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int32]
         return lib
@@ -129,4 +133,40 @@ def gather(array: np.ndarray, indices: np.ndarray,
         indices.ctypes.data_as(ctypes.c_void_p),
         out.ctypes.data_as(ctypes.c_void_p),
         len(indices), row_bytes, threads)
+    return out
+
+
+def gather_windows(array: np.ndarray, starts: np.ndarray, window: int,
+                   threads: int = 0) -> np.ndarray:
+    """Gather ``[len(starts), window]`` element windows from a flat array.
+
+    The LM-corpus hot path: ``array`` is typically a read-only memmap of
+    token ids and ``starts`` the (possibly overlapping) sample offsets —
+    each window is one contiguous memcpy straight from the page cache,
+    multithreaded with the GIL released, instead of numpy's per-element
+    fancy indexing over a ``[batch, window]`` position matrix. Falls back
+    to equivalent numpy indexing when the native library is missing or the
+    inputs are not window-gatherable. Bit-identical either way.
+    """
+    lib = library()
+    starts = np.asarray(starts)
+    native_ok = (
+        lib is not None and array.ndim == 1 and window > 0
+        and starts.ndim == 1 and starts.dtype.kind in 'iu'
+        and array.flags.c_contiguous and not array.dtype.hasobject
+        and (len(starts) == 0
+             or (int(starts.min()) >= 0
+                 and int(starts.max()) + window <= len(array))))
+    if not native_ok:
+        positions = (np.asarray(starts, np.int64)[:, None]
+                     + np.arange(window)[None, :])
+        return array[positions]
+    starts = np.ascontiguousarray(starts, dtype=np.int64)
+    byte_starts = starts * array.dtype.itemsize
+    out = np.empty((len(starts), window), array.dtype)
+    lib.ts_gather_windows(
+        array.ctypes.data_as(ctypes.c_void_p),
+        byte_starts.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        len(starts), window * array.dtype.itemsize, threads)
     return out

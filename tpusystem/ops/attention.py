@@ -49,9 +49,11 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
     when a mesh is passed; attention-probability dropout runs in-kernel
     (positional hash masks regenerated in the backward).
     ``'ring'``/``'ulysses'`` are the sequence-parallel variants (need
-    ``mesh`` with a seq axis); they take full-head tensors, so grouped KV
-    is repeated up to the query head count first; probability dropout is
-    not implemented there.
+    ``mesh`` with a seq axis); grouped KV stays grouped on the ring
+    variants (group-factor fewer ppermute bytes, KV shared across each
+    query-head group by the flash inner kernel) and is broadcast only for
+    ulysses, whose all_to_all splits the head axis; probability dropout
+    is not implemented there.
     """
     if kernel == 'xla':
         return dot_product_attention(query, key, value, causal=causal,
@@ -70,7 +72,10 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
                          f"on the 'xla' and 'flash' kernels, not {kernel!r}")
     if kernel in ('ring', 'ulysses'):
         from tpusystem.ops.ring import ring_self_attention
-        key, value = repeat_kv_heads(query, key, value)
+        # grouped KV stays grouped on the ring: the rotating ppermutes then
+        # move group-factor fewer bytes and the flash inner kernel shares
+        # KV across each query-head group itself (ulysses repeats inside
+        # ring_self_attention — its all_to_all splits the head axis)
         if mesh is None:
             raise ValueError(
                 f'{kernel!r} attention needs a mesh with a seq axis '
