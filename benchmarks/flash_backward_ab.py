@@ -1,0 +1,74 @@
+"""A/B: fused single-pass dq+dk+dv flash backward vs the split dq/dkv pair.
+
+Times ``jax.grad`` of a flash-attention loss (fwd+bwd, the training shape)
+at the headline and long-context shapes on the real chip. The fused kernel
+recomputes scores and dprobs once per block instead of twice — 5 backward
+matmuls instead of 7 — at the cost of a partial-dq HBM array when the KV
+tiling has more than one step (``(kv_steps, bh, seq, d)``, summed after).
+
+Run: ``python benchmarks/flash_backward_ab.py``
+"""
+
+from __future__ import annotations
+
+import sys
+sys.path.insert(0, str(__import__('pathlib').Path(__file__).parent.parent))
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusystem.ops.pallas.flash import flash_attention
+
+HEADS, HEAD_DIM = 12, 64
+SHAPES = [  # (batch, seq) — headline then the long-context ladder
+    (16, 1024),
+    (4, 4096),
+    (2, 8192),
+    (1, 16384),
+]
+REPEATS = 20
+
+
+def time_backward(batch: int, seq: int, backward: str) -> float:
+    rng = np.random.default_rng(0)
+    shape = (batch, seq, HEADS, HEAD_DIM)
+    q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+               for _ in range(3))
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, causal=True, backward=backward)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def run(q, k, v):
+        def body(i, carry):
+            dq, dk, dv = grad(q + carry[0] * 0, k, v)  # defeat hoisting
+            return dq, dk, dv
+        return jax.lax.fori_loop(0, REPEATS, body, (q, k, v))
+
+    out = run(q, k, v)
+    float(out[0].astype(jnp.float32).sum())  # force completion via relay
+    start = time.perf_counter()
+    out = run(q, k, v)
+    float(out[0].astype(jnp.float32).sum())
+    return (time.perf_counter() - start) / REPEATS
+
+
+def main() -> None:
+    for batch, seq in SHAPES:
+        split = time_backward(batch, seq, 'split')
+        fused = time_backward(batch, seq, 'fused')
+        # charged attention matmul FLOPs (fwd 2 + bwd 4 of 2*S^2/2*D each,
+        # causal halves the block area asymptotically — report raw ratio)
+        print(f'b{batch} s{seq}: split {split * 1e3:8.3f} ms  '
+              f'fused {fused * 1e3:8.3f} ms  speedup {split / fused:6.3f}x')
+
+
+if __name__ == '__main__':
+    main()

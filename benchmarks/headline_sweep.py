@@ -16,6 +16,7 @@ from tpusystem.train import (AdamW, ChunkedNextTokenLoss, build_train_step,
 
 
 def variant(tag, batch=16, seq=1024, chunks=8, steps=60, **model_overrides):
+    """One timed recipe; prints MFU + ms/step (+ tok/s for long context)."""
     config = dict(dropout=0.0, attention='flash', vocab_size=50304,
                   return_features=True)
     config.update(model_overrides)
@@ -47,7 +48,8 @@ def variant(tag, batch=16, seq=1024, chunks=8, steps=60, **model_overrides):
     step_flops = 6 * params_count * batch * seq + attention_flops
     mfu = step_flops * steps / elapsed / peak_flops(jax.devices()[0])
     print(json.dumps({'variant': tag, 'mfu': round(mfu, 4),
-                      'ms_per_step': round(elapsed / steps * 1e3, 1)}))
+                      'ms_per_step': round(elapsed / steps * 1e3, 1),
+                      'tok_per_s': round(batch * seq * steps / elapsed)}))
     return mfu
 
 
@@ -59,12 +61,20 @@ def safe(tag, **kw):
 
 
 if __name__ == '__main__':
-    safe('baseline b16 c8 s60')
-    safe('repeat   b16 c8 s60')
-    safe('batch 24', batch=24)
-    safe('chunks 4', chunks=4)
-    safe('steps 90', steps=90)
-    # scan_layers: the relay's AOT compile helper 500s on the scan+pallas
-    # composition (runtime path works on CPU; compile-time win measured in
-    # compile_time.py) — keep it out of the default sweep
-    safe('steps 120', steps=120)
+    if 'long' in sys.argv[1:]:
+        # long-context ladder (BASELINE.md): 125M body, remat + fused loss
+        # + flash, constant 16k tokens per step
+        for batch, seq in [(4, 4096), (2, 8192), (1, 16384)]:
+            safe(f'long b{batch} s{seq}', batch=batch, seq=seq, steps=30,
+                 max_seq=seq, remat=True)
+    else:
+        safe('baseline b16 c8 s60')
+        safe('repeat   b16 c8 s60')
+        safe('batch 24', batch=24)
+        safe('chunks 4', chunks=4)
+        safe('steps 90', steps=90)
+        # scan_layers: the relay's AOT compile helper 500s on the
+        # scan+pallas composition (runtime path works on CPU; compile-time
+        # win measured in compile_time.py) — keep it out of the default
+        # sweep
+        safe('steps 120', steps=120)

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 from tpusystem import Aggregate
 from tpusystem.parallel import batch_sharding, replicated
 from tpusystem.registry import gethash
-from tpusystem.train import (build_eval_step, build_train_step, flax_apply,
+from tpusystem.train import (build_eval_step, build_multi_eval_step,
+                             build_multi_step, build_train_step, flax_apply,
                              init_state)
 
 
@@ -37,6 +38,15 @@ class Classifier(Aggregate):
         apply_fn = flax_apply(network)
         self._train_step = build_train_step(apply_fn, criterion, optimizer)
         self._eval_step = build_eval_step(apply_fn, criterion)
+        # N steps per host dispatch (one lax.scan, one compiled program):
+        # predictions stack reduced to argmax so metrics stay exact
+        predictions = lambda outputs: jnp.argmax(outputs, -1)
+        self._train_many = build_multi_step(
+            build_train_step(apply_fn, criterion, optimizer, jit=False),
+            outputs_fn=predictions)
+        self._eval_many = build_multi_eval_step(
+            build_eval_step(apply_fn, criterion, jit=False),
+            outputs_fn=predictions)
 
     @property
     def id(self) -> str:
@@ -60,6 +70,14 @@ class Classifier(Aggregate):
         return tuple(jax.device_put(part, batch_sharding(self.mesh))
                      for part in batch)
 
+    def shard_batches(self, stacked: tuple) -> tuple:
+        """Place [steps, batch, ...] stacks: the batch axis (dim 1)
+        shards over (data, fsdp); the steps axis stays whole."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        spec = PartitionSpec(None, *batch_sharding(self.mesh).spec)
+        return tuple(jax.device_put(part, NamedSharding(self.mesh, spec))
+                     for part in stacked)
+
     def fit(self, inputs, targets):
         """One optimization step; returns (predictions, loss) on device."""
         self.state, (outputs, loss) = self._train_step(self.state, inputs, targets)
@@ -69,6 +87,16 @@ class Classifier(Aggregate):
         """Deterministic forward; returns (predictions, loss) on device."""
         outputs, loss = self._eval_step(self.state, inputs, targets)
         return jnp.argmax(outputs, -1), loss
+
+    def fit_many(self, inputs, targets):
+        """N optimization steps in one dispatch over [N, batch, ...]
+        stacks; returns (predictions [N, batch], losses [N])."""
+        self.state, (predictions, losses) = self._train_many(
+            self.state, inputs, targets)
+        return predictions, losses
+
+    def evaluate_many(self, inputs, targets):
+        return self._eval_many(self.state, inputs, targets)
 
     def onepoch(self) -> None:
         """Commit domain events at every epoch edge — enqueued exceptions

@@ -10,14 +10,23 @@ the aggregate's ``shard_batch``.
 
 from __future__ import annotations
 
-from tpusystem.depends import Provider
+from tpusystem.depends import Depends, Provider
 from tpusystem.observe import StepTimer
 from tpusystem.observe.events import Iterated, Trained, Validated
 from tpusystem.services import Producer, Service
+from tpusystem.train import grouped_batches
 
 provider = Provider()
 service = Service(provider=provider)
 producer = Producer()
+
+
+def steps_per_dispatch() -> int:
+    """Train/validate steps per host dispatch (override at the
+    composition root; N > 1 amortizes the per-dispatch host cost over N
+    batches via one compiled lax.scan — events/metrics keep phase
+    cadence)."""
+    return 1
 
 
 @service.handler
@@ -37,28 +46,45 @@ def iterate(model, loaders, metrics) -> None:
 
 
 @service.handler
-def train(model, loader, metrics) -> None:
+def train(model, loader, metrics,
+          dispatch: int = Depends(steps_per_dispatch)) -> None:
     model.phase = 'train'
     timer = StepTimer(producer).start()
     loss = None
-    for batch in loader:
-        inputs, targets = model.shard_batch(batch)
-        predictions, loss = model.fit(inputs, targets)
-        metrics.update(loss, predictions, targets)
+    if dispatch == 1 or not hasattr(model, 'fit_many'):
+        # per-batch path — the Model protocol's surface (fit/shard_batch);
+        # models without the aggregate-level fit_many stay here
+        for batch in loader:
+            inputs, targets = model.shard_batch(batch)
+            predictions, loss = model.fit(inputs, targets)
+            metrics.update(loss, predictions, targets)
+    else:
+        # N steps per host dispatch (aggregate-level fit_many)
+        for batch_stack in grouped_batches(loader, dispatch):
+            inputs, targets = model.shard_batches(batch_stack)
+            predictions, loss = model.fit_many(inputs, targets)
+            metrics.update(loss, predictions, targets)
     results = metrics.compute()           # the one device->host sync
     timer.stop(model, 'train', steps=len(loader), result=loss)
     producer.dispatch(Trained(model, results))
 
 
 @service.handler
-def validate(model, loader, metrics) -> None:
+def validate(model, loader, metrics,
+             dispatch: int = Depends(steps_per_dispatch)) -> None:
     model.phase = 'evaluation'
     timer = StepTimer(producer).start()
     loss = None
-    for batch in loader:
-        inputs, targets = model.shard_batch(batch)
-        predictions, loss = model.evaluate(inputs, targets)
-        metrics.update(loss, predictions, targets)
+    if dispatch == 1 or not hasattr(model, 'evaluate_many'):
+        for batch in loader:
+            inputs, targets = model.shard_batch(batch)
+            predictions, loss = model.evaluate(inputs, targets)
+            metrics.update(loss, predictions, targets)
+    else:
+        for batch_stack in grouped_batches(loader, dispatch):
+            inputs, targets = model.shard_batches(batch_stack)
+            predictions, loss = model.evaluate_many(inputs, targets)
+            metrics.update(loss, predictions, targets)
     results = metrics.compute()
     timer.stop(model, 'evaluation', steps=len(loader), result=loss)
     producer.dispatch(Validated(model, results))

@@ -4,9 +4,13 @@ mkdocstrings directive names an importable module — so the CI docs job
 can't check (mkdocs itself is not installed here)."""
 
 import importlib
+import os
 import pathlib
 import re
+import subprocess
+import sys
 
+import pytest
 import yaml
 
 REPO = pathlib.Path(__file__).parent.parent
@@ -45,3 +49,21 @@ def test_api_pages_cover_every_module_and_import():
     assert modules == directives, (
         f'API pages out of sync: missing {modules - directives}, '
         f'stale {directives - modules}')
+
+
+@pytest.mark.slow
+def test_coverage_md_test_count_matches_collection():
+    """COVERAGE.md's "Totals: N tests" line must equal what pytest
+    actually collects — the count drifted in two consecutive rounds when
+    maintained by hand, so it is now pinned by construction."""
+    out = subprocess.run(
+        [sys.executable, '-m', 'pytest', 'tests/', '--collect-only', '-q',
+         '-m', '', '-p', 'no:cacheprovider'],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu'}).stdout
+    collected = int(re.search(r'(\d+) tests collected', out).group(1))
+    written = int(re.search(r'Totals: (\d+) tests',
+                            (REPO / 'COVERAGE.md').read_text()).group(1))
+    assert written == collected, (
+        f'COVERAGE.md says {written} tests but collection finds '
+        f'{collected} — update the Totals line')

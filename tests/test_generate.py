@@ -243,3 +243,31 @@ def test_speculative_sampling_matches_target_distribution():
         assert distance < 0.12, (position, distance)
         # the test has teeth: the distribution is genuinely spread out
         assert ref_hist.max() < 0.9
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('family', ['gpt2', 'llama'])
+def test_generate_on_scanned_model_matches_unrolled(family):
+    """Decode-mode KV caches ride nn.scan (variable_axes={'cache': 0}):
+    generation from a scanned model must equal the unrolled model's
+    token-for-token, given transplanted weights."""
+    import jax
+    from tpusystem.models import gpt2_tiny, llama_tiny
+    if family == 'gpt2':
+        unrolled = gpt2_tiny(layers=4, dtype='float32')
+        scanned = gpt2_tiny(layers=4, scan_layers=True, dtype='float32')
+        prefix, stacked_key = 'h_', 'hs'
+    else:
+        unrolled = llama_tiny(layers=4, dtype='float32')
+        scanned = llama_tiny(layers=4, scan_layers=True, dtype='float32')
+        prefix, stacked_key = 'layer_', 'blocks'
+    prompt = jnp.asarray(
+        np.random.default_rng(11).integers(0, 256, (2, 8)), jnp.int32)
+    params = unrolled.init(jax.random.PRNGKey(3), prompt)['params']
+    per_layer = [params[f'{prefix}{i}'] for i in range(4)]
+    stacked = {k: v for k, v in params.items() if not k.startswith(prefix)}
+    stacked[stacked_key] = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves), *per_layer)
+    out_u = generate(unrolled, params, prompt, steps=6)
+    out_s = generate(scanned, stacked, prompt, steps=6)
+    np.testing.assert_array_equal(np.asarray(out_u), np.asarray(out_s))

@@ -329,3 +329,38 @@ def test_resnet_data_parallel():
 def test_graft_entry_dryrun():
     import __graft_entry__
     __graft_entry__.dryrun_multichip(8)
+
+
+@pytest.mark.slow
+def test_gpt2_scanned_moe_matches_unrolled():
+    """MoE-every-k stacks ride nn.scan over (dense*, moe) SPANS
+    (BlockSpan): logits and router aux must match the unrolled
+    heterogeneous stack given transplanted weights."""
+    from tpusystem.models import GPT2
+    cfg = dict(vocab_size=64, layers=4, dim=32, heads=4, max_seq=32,
+               dropout=0.0, dtype='float32', moe_experts=4, moe_every=2)
+    tokens = jnp.asarray(np.random.default_rng(9).integers(0, 64, (2, 16)),
+                         jnp.int32)
+    unrolled = GPT2(**cfg)
+    scanned = GPT2(**cfg, scan_layers=True)
+    params = unrolled.init(jax.random.PRNGKey(0), tokens)['params']
+    # span i = {d_0: h_{2i} (dense), moe_block: h_{2i+1} (moe)}
+    spans = [{'d_0': params['h_0'], 'moe_block': params['h_1']},
+             {'d_0': params['h_2'], 'moe_block': params['h_3']}]
+    stacked = {k: v for k, v in params.items() if not k.startswith('h_')}
+    stacked['hs'] = jax.tree.map(lambda *leaves: jnp.stack(leaves), *spans)
+    fresh = scanned.init(jax.random.PRNGKey(0), tokens)['params']
+    assert jax.tree.structure(fresh) == jax.tree.structure(stacked)
+    logits_u, aux_u = unrolled.apply({'params': params}, tokens)
+    logits_s, aux_s = scanned.apply({'params': stacked}, tokens)
+    np.testing.assert_allclose(np.asarray(logits_u), np.asarray(logits_s),
+                               atol=2e-5)
+    np.testing.assert_allclose(float(aux_u), float(aux_s), rtol=1e-5)
+
+
+def test_gpt2_scan_layers_moe_needs_divisible_layers():
+    from tpusystem.models import GPT2
+    module = GPT2(vocab_size=64, layers=3, dim=32, heads=4, max_seq=32,
+                  moe_experts=4, moe_every=2, scan_layers=True)
+    with pytest.raises(ValueError, match='divisible by moe_every'):
+        module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))

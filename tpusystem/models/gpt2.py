@@ -21,6 +21,31 @@ from tpusystem.ops.precision import head_logits
 from tpusystem.registry import register
 
 
+def _carry_constraint(mesh):
+    """Sharding pin for the scan-over-layers carry in the TP x FSDP
+    composition: batch over ``data``, hidden dim over ``fsdp``.
+
+    Without a pin, GSPMD gives the scan carry a batch-over-(data, fsdp)
+    layout at the loop boundary while the body's FSDP-scattered weight
+    grads want the carry dim-sharded — an unplannable transition that
+    falls back to an involuntary full rematerialization per layer
+    (spmd_partitioner.cc 'last resort' replicate-then-repartition; the
+    round-3 dryrun warnings). Pinning the carry to P(data, None, fsdp)
+    matches the layout the partitioner itself targets inside the body —
+    measured 2 warnings -> 0 on the 2x2x2 dryrun mesh, identical loss.
+    Meshes without both axes active keep GSPMD's own (already
+    transition-free) choice."""
+    if mesh is None:
+        return lambda hidden: hidden
+    from tpusystem.parallel.mesh import DATA, FSDP, MODEL
+    shape = dict(mesh.shape)
+    if shape.get(FSDP, 1) < 2 or shape.get(MODEL, 1) < 2:
+        return lambda hidden: hidden
+    from jax.sharding import NamedSharding
+    sharding = NamedSharding(mesh, P(DATA, None, FSDP))
+    return lambda hidden: jax.lax.with_sharding_constraint(hidden, sharding)
+
+
 class SelfAttention(nn.Module):
     """Causal multi-head self-attention with a pluggable kernel.
 
@@ -44,6 +69,7 @@ class SelfAttention(nn.Module):
     attn_dropout: float | None = None  # None -> follow `dropout`
     decode: bool = False   # KV-cache incremental decoding (xla kernel only)
     max_seq: int = 1024    # cache capacity when decoding
+    per_row_decode: bool = False  # per-row cache cursors (speculative decoding)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -64,7 +90,8 @@ class SelfAttention(nn.Module):
         query, key, value = (t.reshape(shape) for t in (query, key, value))
         if self.decode:
             from tpusystem.ops.attention import cached_attention
-            context = cached_attention(self, query, key, value, self.max_seq)
+            context = cached_attention(self, query, key, value, self.max_seq,
+                                       per_row=self.per_row_decode)
         else:
             dropout = attn_dropout if train else 0.0
             context = attend(
@@ -89,6 +116,7 @@ class Block(nn.Module):
     attn_dropout: float | None = None
     decode: bool = False
     max_seq: int = 1024
+    per_row_decode: bool = False
     moe_experts: int = 0
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -102,6 +130,7 @@ class Block(nn.Module):
                                  kernel=self.attention, mesh=self.mesh,
                                  attn_dropout=self.attn_dropout,
                                  decode=self.decode, max_seq=self.max_seq,
+                                 per_row_decode=self.per_row_decode,
                                  name='attn')(
             normed.astype(self.dtype), train)
         attended = nn.Dropout(self.dropout, deterministic=not train)(attended)
@@ -124,6 +153,50 @@ class Block(nn.Module):
         shrunk = nn.Dropout(self.dropout, deterministic=not train)(shrunk)
         hidden = hidden + shrunk
         return (hidden, aux) if self.moe_experts else hidden
+
+
+class BlockSpan(nn.Module):
+    """``span`` consecutive blocks, the last one MoE.
+
+    The homogeneous unit that lets a MoE-every-k stack ride ``nn.scan``:
+    scanning over ``layers/span`` identical spans compiles ONE span body
+    (``span - 1`` dense blocks + 1 MoE block) instead of unrolling the
+    heterogeneous stack. Returns ``(hidden, aux)`` like a MoE
+    :class:`Block`."""
+
+    heads: int
+    mlp_ratio: int
+    dropout: float
+    dtype: jnp.dtype
+    span: int = 2
+    attention: str = 'xla'
+    mesh: object = None
+    attn_dropout: float | None = None
+    decode: bool = False
+    max_seq: int = 1024
+    per_row_decode: bool = False
+    moe_experts: int = 0
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_exchange: str = 'quota'
+
+    @nn.compact
+    def __call__(self, hidden, train: bool = False):
+        common = dict(attention=self.attention, mesh=self.mesh,
+                      attn_dropout=self.attn_dropout, decode=self.decode,
+                      max_seq=self.max_seq,
+                      per_row_decode=self.per_row_decode)
+        for index in range(self.span - 1):
+            hidden = Block(self.heads, self.mlp_ratio, self.dropout,
+                           self.dtype, name=f'd_{index}',
+                           **common)(hidden, train)
+        hidden, aux = Block(self.heads, self.mlp_ratio, self.dropout,
+                            self.dtype, moe_experts=self.moe_experts,
+                            moe_k=self.moe_k,
+                            moe_capacity_factor=self.moe_capacity_factor,
+                            moe_exchange=self.moe_exchange,
+                            name='moe_block', **common)(hidden, train)
+        return hidden, aux
 
 
 class GPT2(nn.Module):
@@ -153,6 +226,10 @@ class GPT2(nn.Module):
     # chunked LM loss (train.ChunkedNextTokenLoss) instead of full logits
     decode: bool = False  # KV-cache autoregressive decoding (see
     # tpusystem.train.generate; apply with mutable=['cache'])
+    per_row_decode: bool = False  # per-row cache cursors: cache writes use a
+    # 2D gather-index scatter so rows advance independently (speculative
+    # decoding); False keeps ordinary decode on the faster
+    # dynamic_update_slice at the shared cursor
     moe_experts: int = 0  # >0: MoE FFN in every `moe_every`-th block
     moe_every: int = 2
     moe_k: int = 2
@@ -188,23 +265,49 @@ class GPT2(nn.Module):
         if self.scan_layers:
             # one compiled block body, stacked params, lax.scan over depth —
             # compile time is O(1) in layer count instead of O(layers).
-            # Heterogeneous stacks (MoE every k-th block) and per-layer
-            # cache variables (decode) stay on the unrolled path.
-            if self.moe_experts or self.decode:
-                raise ValueError('scan_layers supports homogeneous '
-                                 'non-decode stacks (no moe_experts, no '
-                                 'decode)')
-            template = block_cls(self.heads, self.mlp_ratio, self.dropout,
-                                 compute_dtype, attention=self.attention,
-                                 mesh=self.mesh,
-                                 attn_dropout=self.attn_dropout,
-                                 max_seq=self.max_seq, name='hs')
+            # MoE-every-k stacks scan over homogeneous (dense*, moe) SPANS
+            # (BlockSpan); decode-mode KV caches scan along with the params
+            # (variable_axes carries the 'cache' collection, so each layer
+            # slice owns its cache at a leading layer dim).
+            common = dict(attention=self.attention, mesh=self.mesh,
+                          attn_dropout=self.attn_dropout,
+                          decode=self.decode, max_seq=self.max_seq,
+                          per_row_decode=self.per_row_decode)
+            constrain = _carry_constraint(self.mesh)
+            if self.moe_experts:
+                if self.layers % self.moe_every:
+                    raise ValueError(
+                        f'scan_layers with moe_experts needs layers '
+                        f'({self.layers}) divisible by moe_every '
+                        f'({self.moe_every}) — the scan unit is one span '
+                        f'of moe_every blocks')
+                span_cls = (nn.remat(BlockSpan, static_argnums=(2,))
+                            if self.remat else BlockSpan)
+                template = span_cls(self.heads, self.mlp_ratio,
+                                    self.dropout, compute_dtype,
+                                    span=self.moe_every,
+                                    moe_experts=self.moe_experts,
+                                    moe_k=self.moe_k,
+                                    moe_capacity_factor=self.moe_capacity_factor,
+                                    moe_exchange=self.moe_exchange,
+                                    name='hs', **common)
+                length = self.layers // self.moe_every
+                body = lambda block, carry, _: block(constrain(carry), train)
+            else:
+                template = block_cls(self.heads, self.mlp_ratio,
+                                     self.dropout, compute_dtype,
+                                     name='hs', **common)
+                length = self.layers
+                body = lambda block, carry, _: (block(constrain(carry),
+                                                      train), None)
             scan = nn.scan(
-                lambda block, carry, _: (block(carry, train), None),
-                variable_axes={'params': 0},
+                body,
+                variable_axes={'params': 0, 'cache': 0},
                 split_rngs={'params': True, 'dropout': True},
-                length=self.layers)
-            hidden, _ = scan(template, hidden, None)
+                length=length)
+            hidden, aux_stack = scan(template, hidden, None)
+            if self.moe_experts:
+                aux_losses.append(jnp.mean(aux_stack))
         else:
             for index in range(self.layers):
                 is_moe = (self.moe_experts > 0
@@ -214,6 +317,7 @@ class GPT2(nn.Module):
                                   mesh=self.mesh,
                                   attn_dropout=self.attn_dropout,
                                   decode=self.decode, max_seq=self.max_seq,
+                                  per_row_decode=self.per_row_decode,
                                   moe_experts=self.moe_experts if is_moe else 0,
                                   moe_k=self.moe_k,
                                   moe_capacity_factor=self.moe_capacity_factor,
@@ -268,11 +372,21 @@ class GPT2(nn.Module):
         splits shifted one dim right past the leading layer axis).
         """
         from tpusystem.ops.moe import moe_partition_rules
+        from tpusystem.parallel.mesh import EXPERT
         return (
-            (r'hs/attn/qkv/kernel$', P(None, None, 'model')),
-            (r'hs/attn/out/kernel$', P(None, 'model', None)),
-            (r'hs/fc/kernel$', P(None, None, 'model')),
-            (r'hs/proj/kernel$', P(None, 'model', None)),
+            # `hs/.*` covers both the plain scanned stack (hs/attn/...)
+            # and BlockSpan nesting (hs/d_0/attn/..., hs/moe_block/attn/...)
+            # — either way one leading layer/span dim shifts the spec right
+            (r'hs/.*attn/qkv/kernel$', P(None, None, 'model')),
+            (r'hs/.*attn/out/kernel$', P(None, 'model', None)),
+            (r'hs/.*fc/kernel$', P(None, None, 'model')),
+            (r'hs/.*proj/kernel$', P(None, 'model', None)),
+            # scanned MoE expert stacks: span dim first, then experts
+            (r'hs/.*moe/w1$', P(None, EXPERT, None, 'model')),
+            (r'hs/.*moe/b1$', P(None, EXPERT, 'model')),
+            (r'hs/.*moe/w2$', P(None, EXPERT, 'model', None)),
+            (r'hs/.*moe/b2$', P(None, EXPERT, None)),
+            (r'hs/.*moe/router$', P()),
             (r'attn/qkv/kernel$', P(None, 'model')),
             (r'attn/out/kernel$', P('model', None)),
             (r'fc/kernel$', P(None, 'model')),
@@ -385,9 +499,14 @@ class GPT2Pipelined:
         from tpusystem.parallel.pipeline import pipeline_apply
         params = variables['params']
         hidden = self._embed(params, tokens)
-        hidden = pipeline_apply(self._block_fn(), self._flat_stack(params['h']),
+        # chunk-major stack passes straight through: pipeline_apply's
+        # interleaved forward schedule shares pipeline_train's layout, so
+        # the GPipe path gets the same (S-1)/v fill/drain bubble shrink
+        hidden = pipeline_apply(self._block_fn(), params['h'],
                                 hidden, self.mesh,
-                                microbatches=self.microbatches, remat=self.remat)
+                                microbatches=self.microbatches,
+                                remat=self.remat,
+                                interleave=self.interleave)
         return self._head(params, hidden)
 
     def sequential_apply(self, variables, tokens):

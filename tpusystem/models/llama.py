@@ -103,6 +103,7 @@ class LlamaAttention(nn.Module):
     mesh: object = None
     decode: bool = False
     max_seq: int = 8192
+    per_row_decode: bool = False  # per-row cache cursors (speculative decoding)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -134,7 +135,8 @@ class LlamaAttention(nn.Module):
 
         if self.decode:
             from tpusystem.ops.attention import cached_attention
-            context = cached_attention(self, query, key, value, self.max_seq)
+            context = cached_attention(self, query, key, value, self.max_seq,
+                                       per_row=self.per_row_decode)
         else:
             context = attend(query, key, value, kernel=self.kernel,
                              mesh=self.mesh, causal=True)
@@ -154,6 +156,7 @@ class LlamaBlock(nn.Module):
     mesh: object = None
     decode: bool = False
     max_seq: int = 8192
+    per_row_decode: bool = False
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -162,7 +165,8 @@ class LlamaBlock(nn.Module):
         hidden = hidden + LlamaAttention(
             self.heads, self.kv_heads, self.dtype, self.rope_theta,
             kernel=self.attention, mesh=self.mesh, decode=self.decode,
-            max_seq=self.max_seq, name='attn')(normed, train)
+            max_seq=self.max_seq, per_row_decode=self.per_row_decode,
+            name='attn')(normed, train)
         normed = RMSNorm(name='ffn_norm')(hidden)
         dense = lambda features, name: nn.Dense(
             features, use_bias=False, dtype=self.dtype, name=name)
@@ -200,6 +204,9 @@ class Llama(nn.Module):
     # full f32 logits tensor is the dominant memory term
     decode: bool = False  # KV-cache autoregressive decoding (see
     # tpusystem.train.generate; apply with mutable=['cache'])
+    per_row_decode: bool = False  # per-row cache cursors for speculative
+    # decoding (scatter writes); False = ordinary decode, shared-cursor
+    # dynamic_update_slice cache writes
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -213,17 +220,21 @@ class Llama(nn.Module):
                      if self.remat else LlamaBlock)
         if self.scan_layers:
             # one compiled block body + stacked params: compile time is
-            # O(1) in depth. Decode stays unrolled (per-layer cache vars).
-            if self.decode:
-                raise ValueError('scan_layers does not support decode '
-                                 '(per-layer KV-cache variables)')
+            # O(1) in depth. Decode scans too: the per-layer KV caches ride
+            # the scan via variable_axes={'cache': 0} (each layer slice
+            # owns its cache at a leading layer dim).
             template = block_cls(self.heads, self.kv_heads, self.ffn_dim,
                                  compute_dtype, self.rope_theta,
                                  attention=self.attention, mesh=self.mesh,
-                                 max_seq=self.max_seq, name='blocks')
+                                 decode=self.decode, max_seq=self.max_seq,
+                                 per_row_decode=self.per_row_decode,
+                                 name='blocks')
+            from tpusystem.models.gpt2 import _carry_constraint
+            constrain = _carry_constraint(self.mesh)
             scan = nn.scan(
-                lambda block, carry, _: (block(carry, train), None),
-                variable_axes={'params': 0},
+                lambda block, carry, _: (block(constrain(carry), train),
+                                         None),
+                variable_axes={'params': 0, 'cache': 0},
                 split_rngs={'params': True},
                 length=self.layers)
             hidden, _ = scan(template, hidden, None)
@@ -233,6 +244,7 @@ class Llama(nn.Module):
                                    compute_dtype, self.rope_theta,
                                    attention=self.attention, mesh=self.mesh,
                                    decode=self.decode, max_seq=self.max_seq,
+                                   per_row_decode=self.per_row_decode,
                                    name=f'layer_{index}')(hidden, train)
         hidden = RMSNorm(name='final_norm')(hidden)
         # untied head (Llama-3 convention). bf16 x bf16 operands at MXU

@@ -86,7 +86,8 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
                      "expected 'xla', 'flash', 'ring' or 'ulysses'")
 
 
-def cached_attention(module, query, key, value, max_seq: int):
+def cached_attention(module, query, key, value, max_seq: int,
+                     per_row: bool = False):
     """Incremental (KV-cache) attention for autoregressive decoding.
 
     Called from inside a flax module in decode mode: maintains
@@ -105,9 +106,14 @@ def cached_attention(module, query, key, value, max_seq: int):
 
     The cursor (``index``) is **per-row** — ``[batch]`` int32 — so rows
     may sit at different depths: speculative decoding advances each
-    sequence by its own acceptance count instead of the batch minimum.
-    Ordinary decode keeps every row equal; the row-indexed cache writes
-    and masks then coincide with the single-cursor formulation.
+    sequence by its own acceptance count instead of the batch minimum
+    (``per_row=True``). Ordinary decode keeps every row equal, and with
+    ``per_row=False`` (default) the cache write uses a single
+    ``dynamic_update_slice`` at the shared cursor instead of a
+    computed-2D-index scatter — on TPU the scatter in the per-token hot
+    loop is the slower lowering. The caller owns the uniformity guarantee
+    (``tpusystem.train.generate`` passes ``per_row`` only on the
+    speculative path).
     """
     batch, length, kv_heads, head_dim = key.shape
     if length > max_seq:
@@ -132,12 +138,26 @@ def cached_attention(module, query, key, value, max_seq: int):
     if module.is_initializing():
         return dot_product_attention(query, key, value, causal=True)
     cursor = index.value                                    # [batch]
-    rows = jnp.arange(batch)[:, None]
     positions = cursor[:, None] + jnp.arange(length)[None, :]   # [B, L]
-    cache_key.value = cache_key.value.at[rows, positions].set(
-        key.astype(cache_key.value.dtype))
-    cache_value.value = cache_value.value.at[rows, positions].set(
-        value.astype(cache_value.value.dtype))
+    if per_row:
+        rows = jnp.arange(batch)[:, None]
+        cache_key.value = cache_key.value.at[rows, positions].set(
+            key.astype(cache_key.value.dtype))
+        cache_value.value = cache_value.value.at[rows, positions].set(
+            value.astype(cache_value.value.dtype))
+    else:
+        # uniform cursor: one dynamic_update_slice writes every row at the
+        # shared offset (cursor[0] — the caller's uniformity contract).
+        # Past-capacity behavior diverges from the scatter path: the slice
+        # start clamps so the write lands at max_seq - length instead of
+        # being dropped — both are inside the caller's capacity contract.
+        start = cursor[0]
+        cache_key.value = jax.lax.dynamic_update_slice(
+            cache_key.value, key.astype(cache_key.value.dtype),
+            (0, start, 0, 0))
+        cache_value.value = jax.lax.dynamic_update_slice(
+            cache_value.value, value.astype(cache_value.value.dtype),
+            (0, start, 0, 0))
     index.value = cursor + length
     if prefill:
         # Long prompts route through the flash kernel: einsum attention

@@ -244,6 +244,82 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _flash_fused_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                            delta_ref, dq_ref, dk_ref, dv_ref,
+                            dk_scr, dv_scr,
+                            *, scale: float, causal: bool,
+                            block_q: int, block_kv: int, group: int,
+                            dropout: float):
+    """Single-pass backward: dq, dk and dv from ONE score recomputation.
+
+    The split backward (:func:`_flash_dq_kernel` + :func:`_flash_dkv_kernel`)
+    computes ``scores = q k^T`` and ``dprobs = do v^T`` twice per visible
+    block — once per kernel. Fused, the seven backward matmuls drop to five
+    (scores, dprobs, dv, dk, dq), a 2/7 cut of the backward's MXU work.
+
+    Grid layout (the splash-attention fused-backward shape): ``(kv_steps,
+    bh, q_steps)`` with the KV dimension OUTERMOST. Within one kv section
+    every query head of a KV group and every q block revisit the same
+    dk/dv output block consecutively, so dk/dv accumulate in VMEM scratch
+    and flush once per (kv head, kv block). dq cannot accumulate across
+    the outer kv dimension (non-consecutive revisits), so each grid step
+    writes its partial to a ``(kv_steps, bh, seq_q, d)`` output that the
+    caller reduces with a plain sum — free at the headline tiling where
+    kv_steps == 1.
+    """
+    kv_idx, head_row, q_idx = (pl.program_id(0), pl.program_id(1),
+                               pl.program_id(2))
+    q_steps = pl.num_programs(2)
+
+    @pl.when(jnp.logical_and(head_row % group == 0, q_idx == 0))
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    visible = _visible(causal, q_idx, kv_idx, block_q, block_kv)
+
+    @pl.when(visible)
+    def _block():
+        query, key, value = q_ref[0], k_ref[0], v_ref[0]
+        grad_out = do_ref[0]
+        scores = _masked_scores(query, key, scale=scale, causal=causal,
+                                q_idx=q_idx, kv_idx=kv_idx,
+                                block_q=block_q, block_kv=block_kv)
+        probs = jnp.exp(scores - lse_ref[0, :, :1])           # (bq, bkv)
+        dprobs = jax.lax.dot_general(
+            grad_out, value, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout:
+            keep = _keep_mask(seed_ref[0], head_row, q_idx, kv_idx,
+                              block_q, block_kv, dropout)
+            kept = probs * keep / (1.0 - dropout)
+            dprobs = keep * dprobs / (1.0 - dropout)
+        else:
+            kept = probs
+        dv_scr[...] += jax.lax.dot_general(
+            kept.astype(grad_out.dtype), grad_out, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bkv, d)
+        dscores = probs * (dprobs - delta_ref[0, :, :1]) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            dscores.astype(query.dtype), query, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dq_ref[0, 0] = jax.lax.dot_general(
+            dscores.astype(key.dtype), key, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+
+    @pl.when(jnp.logical_not(visible))
+    def _skip():
+        # the partial-dq block is written every step (revisit semantics
+        # would otherwise leave the previous block's bytes in the buffer)
+        dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+
+    @pl.when(jnp.logical_and(head_row % group == group - 1,
+                             q_idx == q_steps - 1))
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
 def _fit_block(seq: int, want: int, granule: int = LANES) -> int | None:
     """Largest lane-aligned divisor of ``seq`` that is <= ``want``.
 
@@ -329,14 +405,20 @@ def _seed_wiring(kernel, seed, dropout):
 
 
 def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
-                    dropout, residuals, grad_out, grad_lse):
+                    dropout, backward, residuals, grad_out, grad_lse):
     """Backward for :func:`_flash_lse`. ``grad_lse`` (bh, seq_q) is the
     cotangent of the logsumexp output (ring attention merges chunk results
     by lse, so gradient flows into it; plain ``flash_attention`` discards
     lse and its cotangent arrives as zeros); per-score gradient is
     p*(dprobs - (delta - dlse)), so it folds into the precomputed delta
     term. Under dropout the kernels regenerate the forward's positional
-    keep masks from the same seed."""
+    keep masks from the same seed.
+
+    ``backward``: ``'fused'`` runs the single-pass dq+dk+dv kernel (one
+    score recomputation per block — 5 backward matmuls instead of 7);
+    ``'split'`` keeps the separate dq / dkv sweeps (no partial-dq HBM
+    traffic — the A/B reference, and the fallback if the fused kernel's
+    larger VMEM working set cannot tile)."""
     q, k, v, seed, out, lse = residuals
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
@@ -345,6 +427,45 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
     if grad_lse is not None:
         delta = delta - grad_lse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta, (bh, seq_q, STATS))
+
+    if backward == 'fused':
+        kv_steps, q_steps = seq_kv // block_kv, seq_q // block_q
+        kernel = functools.partial(
+            _flash_fused_bwd_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, group=group, dropout=dropout)
+        seed_args, seed_specs, kernel = _seed_wiring(kernel, seed, dropout)
+        q_row = lambda kv, i, j: (i, j, 0)
+        kv_row = lambda kv, i, j: (i // group, kv, 0)
+        dq_partial, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(kv_steps, bh, q_steps),
+            in_specs=seed_specs + [
+                pl.BlockSpec((1, block_q, head_dim), q_row),
+                pl.BlockSpec((1, block_kv, head_dim), kv_row),
+                pl.BlockSpec((1, block_kv, head_dim), kv_row),
+                pl.BlockSpec((1, block_q, head_dim), q_row),
+                pl.BlockSpec((1, block_q, STATS), q_row),
+                pl.BlockSpec((1, block_q, STATS), q_row),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, head_dim),
+                             lambda kv, i, j: (kv, i, j, 0)),
+                pl.BlockSpec((1, block_kv, head_dim), kv_row),
+                pl.BlockSpec((1, block_kv, head_dim), kv_row),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((kv_steps, bh, seq_q, head_dim), q.dtype),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_kv, head_dim), jnp.float32),
+                pltpu.VMEM((block_kv, head_dim), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*seed_args, q, k, v, grad_out, lse, delta)
+        dq = jnp.sum(dq_partial, axis=0, dtype=jnp.float32).astype(q.dtype)
+        return dq, dk, dv, np.zeros(seed.shape, jax.dtypes.float0)
 
     dq_kernel = functools.partial(
         _flash_dq_kernel, scale=scale, causal=causal,
@@ -407,16 +528,17 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
     return dq, dk, dv, np.zeros(seed.shape, jax.dtypes.float0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
 def _flash_lse(q, k, v, seed, causal, scale, block_q, block_kv, interpret,
-               group, dropout):
+               group, dropout, backward):
     (out, lse), _ = _flash_lse_fwd(q, k, v, seed, causal, scale, block_q,
-                                   block_kv, interpret, group, dropout)
+                                   block_kv, interpret, group, dropout,
+                                   backward)
     return out, lse
 
 
 def _flash_lse_fwd(q, k, v, seed, causal, scale, block_q, block_kv, interpret,
-                   group, dropout):
+                   group, dropout, backward):
     out, residuals = _flash_fwd(q, k, v, seed, causal, scale, block_q,
                                 block_kv, interpret, group, dropout)
     lse = residuals[5][..., 0]                                # (bh, seq_q)
@@ -424,10 +546,11 @@ def _flash_lse_fwd(q, k, v, seed, causal, scale, block_q, block_kv, interpret,
 
 
 def _flash_lse_bwd(causal, scale, block_q, block_kv, interpret, group,
-                   dropout, residuals, grads):
+                   dropout, backward, residuals, grads):
     grad_out, grad_lse = grads
     return _flash_bwd_impl(causal, scale, block_q, block_kv, interpret,
-                           group, dropout, residuals, grad_out, grad_lse)
+                           group, dropout, backward, residuals, grad_out,
+                           grad_lse)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -437,7 +560,8 @@ def flash_attention(query, key, value, *, causal: bool = True,
                     scale: float | None = None,
                     block_q: int = 1024, block_kv: int = 1024,
                     interpret: bool | None = None,
-                    dropout: float = 0.0, dropout_rng=None):
+                    dropout: float = 0.0, dropout_rng=None,
+                    backward: str = 'fused'):
     """Flash attention over [batch, length, heads, head_dim] tensors.
 
     Drop-in for :func:`tpusystem.ops.attention.dot_product_attention`
@@ -462,7 +586,8 @@ def flash_attention(query, key, value, *, causal: bool = True,
     out, _ = flash_attention_lse(query, key, value, causal=causal,
                                  scale=scale, block_q=block_q,
                                  block_kv=block_kv, interpret=interpret,
-                                 dropout=dropout, dropout_rng=dropout_rng)
+                                 dropout=dropout, dropout_rng=dropout_rng,
+                                 backward=backward)
     return out
 
 
@@ -470,7 +595,8 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
                         scale: float | None = None,
                         block_q: int = 1024, block_kv: int = 1024,
                         interpret: bool | None = None,
-                        dropout: float = 0.0, dropout_rng=None):
+                        dropout: float = 0.0, dropout_rng=None,
+                        backward: str = 'fused'):
     """Flash attention that also returns the softmax logsumexp.
 
     Returns ``(out [B,S,H,D], lse [B,S,H] float32)``. The lse output is what
@@ -485,6 +611,11 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
     (see :func:`flash_attention`). The lse output stays the FULL softmax
     denominator (dropout does not renormalize), so blockwise merges are
     unaffected.
+
+    ``backward='fused'`` (default) runs the single-pass dq+dk+dv backward
+    kernel — one score recomputation per block, 5 matmuls instead of the
+    split path's 7; ``'split'`` keeps the separate dq / dkv kernels (the
+    A/B reference and large-tile fallback; see :func:`_flash_bwd_impl`).
     """
     if interpret is None:
         interpret = jax.default_backend() not in ('tpu', 'axon')
@@ -518,9 +649,11 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
     def to_bh(tensor):  # [B,S,H,D] -> [B*H, S, D]
         return tensor.transpose(0, 2, 1, 3).reshape(-1, tensor.shape[1], head_dim)
 
+    if backward not in ('fused', 'split'):
+        raise ValueError(f"backward must be 'fused' or 'split', got {backward!r}")
     out, lse = _flash_lse(to_bh(query), to_bh(key), to_bh(value), seed,
                           causal, scale, block_q, block_kv, interpret, group,
-                          float(dropout))
+                          float(dropout), backward)
     out = out.reshape(batch, q_heads, seq_q, head_dim).transpose(0, 2, 1, 3)
     lse = lse.reshape(batch, q_heads, seq_q).transpose(0, 2, 1)
     return out, lse

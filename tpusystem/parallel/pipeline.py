@@ -27,6 +27,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -38,23 +39,43 @@ BlockFn = Callable[[Any, jax.Array], jax.Array]
 
 
 def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
-                   mesh, *, microbatches: int, remat: bool = True) -> jax.Array:
+                   mesh, *, microbatches: int, remat: bool = True,
+                   interleave: int = 1) -> jax.Array:
     """Run ``hidden`` through a layer stack pipelined over ``stage``.
 
     Args:
         block_fn: pure per-layer function ``(layer_params, x) -> x``.
         stacked_params: pytree whose leaves carry a leading ``layers``
             dimension (e.g. built with ``jax.vmap(block.init)``); ``layers``
-            must be divisible by the mesh's ``stage`` size.
+            must be divisible by the mesh's ``stage`` size. With
+            ``interleave = v > 1`` the leaves are chunk-major
+            ``[v, layers/v, ...]`` (a plain reshape of the layer-major
+            stack) sharded ``P(None, stage)`` — the same layout contract as
+            :func:`pipeline_train`.
         hidden: global activations ``[batch, ...]``; batch must divide by
             ``data*fsdp*microbatches``.
         mesh: mesh with a ``stage`` axis (size 1 degenerates gracefully).
         microbatches: how many microbatches to stream through the pipe.
+        interleave: virtual-pipeline chunks per device. ``v > 1`` shrinks
+            the forward fill/drain bubble from ``S-1`` stage-units to
+            ``(S-1)/v`` (microbatches ride the ring ``v`` times through
+            chunk-sized units — the schedule of :func:`pipeline_train`'s
+            forward slot). Microbatch counts that don't divide the stage
+            count pad the last chunk sweep with idle units (the intrinsic
+            ring-latency bubble of a short group — see
+            :func:`pipeline_train`).
     """
     stages = mesh.shape[STAGE]
-    layers = jax.tree.leaves(stacked_params)[0].shape[0]
-    if layers % stages:
-        raise ValueError(f'{layers} layers not divisible by {stages} stages')
+    chunks = interleave
+    leading = jax.tree.leaves(stacked_params)[0].shape[:2]
+    if chunks > 1 and leading[0] != chunks:
+        raise ValueError(
+            f'interleave={chunks} expects chunk-major stacked params '
+            f'[{chunks}, layers/{chunks}, ...]; got leading dims {leading}')
+    layers = leading[0] if chunks == 1 else chunks * leading[1]
+    if layers % (stages * chunks):
+        raise ValueError(f'{layers} layers not divisible by {stages} stages '
+                         f'x {chunks} chunks')
     data_parallel = mesh.shape[DATA] * mesh.shape[FSDP]
     if hidden.shape[0] % (data_parallel * microbatches):
         raise ValueError(
@@ -62,7 +83,11 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
             f'= {data_parallel}*{microbatches}')
     batch_axes = (DATA, FSDP) if data_parallel > 1 else None
     activation_spec = P(batch_axes, *([None] * (hidden.ndim - 1)))
-    param_specs = jax.tree.map(lambda _: P(STAGE), stacked_params)
+    chunk_spec = P(STAGE) if chunks == 1 else P(None, STAGE)
+    param_specs = jax.tree.map(lambda _: chunk_spec, stacked_params)
+    # a partial last group pads with idle units (see pipeline_train)
+    padded = (microbatches if chunks == 1
+              else -(-microbatches // stages) * stages)
 
     stage_body = _stage_scan(block_fn)
     if remat:
@@ -77,26 +102,58 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
         count = lax.axis_size(STAGE)
         shape = (microbatches, local_hidden.shape[0] // microbatches)
         batches = local_hidden.reshape(shape + local_hidden.shape[1:])
+        if chunks == 1:
+            params_all = jax.tree.map(lambda leaf: leaf[None], params)
+        else:
+            params_all = params
+        span = chunks * count
+
+        def schedule(unit):
+            """Unit index -> (active, chunk, microbatch) — the forward slot
+            of pipeline_train's interleaved schedule; for chunks == 1 it
+            reduces to (0 <= unit < M, 0, unit)."""
+            group, rem = jnp.divmod(unit, span)
+            chunk, pos = jnp.divmod(rem, count)
+            m = group * count + pos
+            active = ((unit >= 0) & (unit < chunks * padded)
+                      & (m < microbatches))
+            return (active, jnp.clip(chunk, 0, chunks - 1),
+                    jnp.clip(m, 0, microbatches - 1))
 
         def tick(state, t):
-            feed = lax.dynamic_index_in_dim(
-                batches, jnp.clip(t, 0, microbatches - 1), keepdims=False)
-            take = jnp.logical_and(stage == 0, t < microbatches)
-            state = jnp.where(take, feed, state)
-            state = stage_body(params, state)
-            emitted = state
+            active, c_f, m_f = schedule(t - stage)
+            feed = lax.dynamic_index_in_dim(batches, m_f, keepdims=False)
+            # a microbatch enters the pipe at stage 0 chunk 0; every later
+            # virtual stage consumes the ring message
+            x = jnp.where((stage == 0) & (c_f == 0), feed, state)
+            params_c = jax.tree.map(
+                lambda leaf: lax.dynamic_index_in_dim(leaf, c_f, 0,
+                                                      keepdims=False),
+                params_all)
+            # idle (fill/drain/pad) ticks skip the block compute: inside
+            # shard_map, cond on a device-varying predicate is real
+            # per-device control flow
+            emitted = lax.cond(active,
+                               lambda: stage_body(params_c, x),
+                               lambda: jnp.zeros_like(x))
             if count > 1:
                 permutation = [(source, (source + 1) % count)
                                for source in range(count)]
-                state = lax.ppermute(state, STAGE, permutation)
+                state = lax.ppermute(emitted, STAGE, permutation)
+            else:
+                state = emitted
             return state, emitted
 
-        ticks = microbatches + count - 1
+        ticks = chunks * padded + count - 1
         state = jnp.zeros_like(batches[0])
         _, emitted = lax.scan(tick, state, jnp.arange(ticks))
-        # the last stage emits microbatch m at tick m + count - 1; broadcast
-        # its slice to the other stages (the out_spec replicates over stage)
-        outputs = lax.slice_in_dim(emitted, count - 1, count - 1 + microbatches)
+        # the last stage emits microbatch m (final chunk) at tick
+        # (m//S)*v*S + (v-1)*S + m%S + S-1 — contiguous [S-1, S-1+M) for
+        # v == 1; gather the group-strided ticks otherwise
+        emit_ticks = np.array(
+            [(m // stages) * span + (chunks - 1) * stages + (m % stages)
+             + stages - 1 for m in range(microbatches)])
+        outputs = jnp.take(emitted, emit_ticks, axis=0)
         outputs = _broadcast_from_last(outputs, stage, count)
         return outputs.reshape(local_hidden.shape)
 
@@ -132,6 +189,7 @@ def _stage_scan(block_fn: BlockFn):
     return run
 
 
+@functools.lru_cache(maxsize=None)
 def _stash_slots(stages: int, interleave: int, microbatches: int) -> int:
     """Smallest per-chunk stash size such that ``m % slots`` indexing never
     clobbers a live microbatch input.
@@ -141,7 +199,9 @@ def _stash_slots(stages: int, interleave: int, microbatches: int) -> int:
     is safe iff that later forward happens strictly after this backward.
     Checked directly against the schedule formulas (see
     :func:`pipeline_train`); for ``interleave == 1`` this recovers the
-    classic 1F1B bound ``2 * stages - 1``.
+    classic 1F1B bound ``2 * stages - 1``. Memoized: the brute-force
+    check is O(slots * interleave * stages * microbatches) of pure Python
+    and otherwise re-runs at every ``pipeline_train`` construction.
     """
     def fwd_tick(c, s, m):
         group, pos = divmod(m, stages)
@@ -223,8 +283,19 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
         microbatches: microbatches per step; batch must divide by
             ``data*fsdp*microbatches``. With interleave the schedule
             sweeps chunks in groups of ``stages`` microbatches; a
-            remainder group is padded with idle units (correct, slightly
-            more bubble), so prefer ``microbatches % stages == 0``.
+            remainder group is padded with idle units, so prefer
+            ``microbatches % stages == 0``. The padding is the schedule's
+            *intrinsic* short-group bubble, not an artifact: advancing a
+            chunk sweep to the next chunk needs the previous chunk's
+            output back from the last device — ``S`` one-tick ``ppermute``
+            hops — and a group of ``R = M % S < S`` microbatches can only
+            cover ``R`` of those ticks with work, so ``S - R`` idle units
+            per chunk transition are forced by the ring latency (a
+            "compressed" sweep would consume activations before they
+            arrive). Total overhead: at most ``v * (S - R)`` idle
+            chunk-units of ``vM + vS + S - 2`` — the same order as the
+            fill/drain bubble itself, and second-order at realistic
+            ``M >= 4S``.
         weight_fn: optional ``(micro_targets) -> scalar`` microbatch weight
             (the masked LM losses' unmasked-token count) — the same
             weighting ``build_train_step(accumulate=...)`` applies, so

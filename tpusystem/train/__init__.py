@@ -1,13 +1,17 @@
 from tpusystem.train.state import TrainState
 from tpusystem.train.step import (build_1f1b_train_step, build_eval_step,
-                                  build_train_step, flax_apply, init_state)
+                                  build_multi_eval_step, build_multi_step,
+                                  build_train_step, flax_apply,
+                                  grouped_batches, init_state)
 from tpusystem.train.optim import SGD, Adam, AdamW, Optimizer
 from tpusystem.train.losses import (ChunkedNextTokenLoss, CrossEntropyLoss,
                                     MSELoss, NextTokenLoss, WithAuxLoss)
 from tpusystem.train.metrics import Accuracy, Mean, Metric, Perplexity, TopKAccuracy
 from tpusystem.train.generate import generate, speculative_generate
 
-__all__ = ['TrainState', 'build_train_step', 'build_1f1b_train_step', 'build_eval_step', 'flax_apply',
+__all__ = ['TrainState', 'build_train_step', 'build_1f1b_train_step', 'build_eval_step',
+           'build_multi_step', 'build_multi_eval_step', 'flax_apply',
+           'grouped_batches',
            'init_state', 'Optimizer', 'SGD', 'Adam', 'AdamW',
            'CrossEntropyLoss', 'MSELoss', 'NextTokenLoss', 'ChunkedNextTokenLoss',
            'WithAuxLoss',

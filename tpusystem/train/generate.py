@@ -19,16 +19,21 @@ import jax
 import jax.numpy as jnp
 
 
-def _decoder(module):
+def _decoder(module, per_row: bool = False):
     """Clone the module into decode mode: xla attention (flash/ring make no
     sense one token at a time), no dropout, logits output (MoE models drop
     their aux/router term — it only exists for the training loss). The
     mesh field is dropped too — the decode path never reads it, and an
-    unhashable live mesh would defeat the compiled-program cache."""
+    unhashable live mesh would defeat the compiled-program cache.
+
+    ``per_row=True`` (the speculative path) switches the KV-cache writes
+    to per-row scatter so each sequence advances by its own acceptance;
+    ordinary generation keeps the faster shared-cursor
+    ``dynamic_update_slice`` (see ``cached_attention``)."""
     updates: dict = {'decode': True}
     for field, value in (('attention', 'xla'), ('dropout', 0.0),
                          ('return_features', False), ('remat', False),
-                         ('mesh', None)):
+                         ('mesh', None), ('per_row_decode', per_row)):
         if hasattr(module, field):
             updates[field] = value
     return dataclasses.replace(module, **updates)
@@ -109,7 +114,8 @@ def speculative_generate(module, params, prompt, *, steps: int,
     if temperature > 0.0 and rng is None:
         raise ValueError('temperature sampling needs an rng key')
     rng = rng if rng is not None else jax.random.PRNGKey(0)
-    decoder, drafter = _decoder(module), _decoder(draft_module)
+    decoder = _decoder(module, per_row=True)
+    drafter = _decoder(draft_module, per_row=True)
     needed = prompt.shape[1] + steps + speculate + 1
     capacity = min(decoder.max_seq, drafter.max_seq)
     if needed > capacity:
@@ -137,7 +143,10 @@ def _rewind(cache, cursor):
 
     def fix(path, leaf):
         if path[-1] in cursors:
-            return jnp.asarray(cursor, leaf.dtype)
+            # scanned stacks carry cursors at a leading layer dim —
+            # broadcast the [batch] cursor to whatever shape the leaf has
+            return jnp.broadcast_to(jnp.asarray(cursor, leaf.dtype),
+                                    leaf.shape)
         return leaf
     return jax.tree_util.tree_map_with_path(fix, cache)
 
@@ -252,6 +261,14 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
             advance = jnp.where(done, 0, accepted + 1)
             produced = produced + advance
             cursor = cursor + advance
+            # rows at/past `steps` keep drafting+verifying (a while_loop has
+            # no per-row exit) — park their cursor at the prompt end so the
+            # dead writes stay inside the audited prompt+steps+speculate+1
+            # capacity window instead of relying on scatter-drop /
+            # gather-clamp semantics past max_seq; their out/token/produced
+            # no longer advance, so outputs are unaffected
+            cursor = jnp.where(produced >= steps,
+                               jnp.minimum(cursor, prefix), cursor)
             token = jnp.where(
                 done, token,
                 jnp.take_along_axis(emitted, accepted[:, None], axis=1)[:, 0])

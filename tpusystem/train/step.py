@@ -26,6 +26,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from tpusystem.train.state import TrainState
@@ -141,6 +142,76 @@ def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
         return state, (outputs, loss)
 
     return jax.jit(step, donate_argnums=0) if jit else step
+
+
+def build_multi_step(step, *, jit: bool = True, outputs_fn=None):
+    """Wrap an (unjitted) train step into N steps per host dispatch.
+
+    ``multi(state, inputs, targets) -> (state, losses)`` where inputs and
+    targets carry a leading ``steps`` dimension (``[N, batch, ...]``) and
+    ``losses`` is the per-step ``[N]`` float32 vector. One ``lax.scan``
+    runs the N steps in a single compiled program, so per-dispatch host
+    overhead (~7 ms through a tunneled-TPU relay; one Python round trip
+    anywhere) is paid once per N batches instead of per batch — the
+    amortization ``bench.py`` applies that the training service otherwise
+    never gets. Per-phase metrics stay exact: feed the whole loss vector
+    to the accumulator (``Mean``/``Perplexity`` accept arrays), and keep
+    events at phase cadence as before.
+
+    ``step`` must be built with ``jit=False`` (it is traced into the scan).
+    Per-step ``outputs`` are dropped by default — stacking N output pytrees
+    would materialize exactly the buffers the fused-loss path avoids. Pass
+    ``outputs_fn`` (e.g. ``lambda o: jnp.argmax(o, -1)`` for classifier
+    predictions) to stack a *reduced* output per step instead; the return
+    becomes ``(state, (stacked_reduced_outputs, losses))``.
+    """
+    def multi(state: TrainState, inputs, targets):
+        def body(state, xs):
+            micro_inputs, micro_targets = xs
+            state, (outputs, loss) = step(state, micro_inputs, micro_targets)
+            loss = jnp.asarray(loss, jnp.float32)
+            if outputs_fn is None:
+                return state, loss
+            return state, (outputs_fn(outputs), loss)
+        return jax.lax.scan(body, state, (inputs, targets))
+    return jax.jit(multi, donate_argnums=0) if jit else multi
+
+
+def build_multi_eval_step(step, *, jit: bool = True, outputs_fn=None):
+    """Eval counterpart of :func:`build_multi_step`:
+    ``multi(state, inputs, targets) -> losses[N]`` (or
+    ``(stacked_reduced_outputs, losses)`` with ``outputs_fn``) over stacked
+    batches (``step`` from ``build_eval_step(..., jit=False)``)."""
+    def multi(state: TrainState, inputs, targets):
+        def body(carry, xs):
+            outputs, loss = step(state, xs[0], xs[1])
+            loss = jnp.asarray(loss, jnp.float32)
+            if outputs_fn is None:
+                return carry, loss
+            return carry, (outputs_fn(outputs), loss)
+        _, ys = jax.lax.scan(body, jnp.int32(0), (inputs, targets))
+        return ys
+    return jax.jit(multi) if jit else multi
+
+
+def grouped_batches(loader, size: int):
+    """Yield tuples of ``[n, batch, ...]`` stacks of up to ``size``
+    consecutive batches — the host-side feeder for
+    :func:`build_multi_step`. Accepts loaders yielding tuples (``(inputs,
+    targets)``) or bare arrays; the tail stack is shorter when the loader
+    length doesn't divide ``size``."""
+    group: list = []
+
+    def flush():
+        return tuple(np.stack(parts) for parts in zip(*group))
+
+    for batch in loader:
+        group.append(batch if isinstance(batch, tuple) else (batch,))
+        if len(group) == size:
+            yield flush()
+            group = []
+    if group:
+        yield flush()
 
 
 def build_eval_step(apply_fn: ApplyFn, criterion: Criterion, *, jit: bool = True):
