@@ -15,7 +15,6 @@ import sys
 sys.path.insert(0, str(__import__('pathlib').Path(__file__).parent.parent))
 
 import time
-from functools import partial
 
 import jax
 import jax.numpy as jnp

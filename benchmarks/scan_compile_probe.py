@@ -39,9 +39,11 @@ def main(argv: list[str]) -> None:
                   if a.startswith('steps=')), 90)
     outer = next((a.split('=')[1] for a in argv
                   if a.startswith('outer=')), 'fori')
+    unit = next((int(a.split('=')[1]) for a in argv
+                 if a.startswith('unit=')), 1)
 
     module = GPT2(dropout=0.0, vocab_size=50304, return_features=True,
-                  layers=layers, scan_layers=scan,
+                  layers=layers, scan_layers=scan, scan_unit=unit,
                   attention='flash' if flash else 'xla', remat=remat)
     optimizer = AdamW(lr=3e-4, grad_clip=1.0)
     tokens = jnp.asarray(
@@ -74,7 +76,7 @@ def main(argv: list[str]) -> None:
     t2 = time.perf_counter()
     del compiled
     print(f'scan={scan} flash={flash} remat={remat} loop={loop} '
-          f'steps={steps} outer={outer} layers={layers}: '
+          f'steps={steps} outer={outer} layers={layers} unit={unit}: '
           f'lower {t1 - t0:7.1f}s  compile {t2 - t1:7.1f}s')
 
 

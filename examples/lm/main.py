@@ -20,7 +20,6 @@ import pathlib
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from tpusystem import Aggregate, Compiler, Depends, Runtime
 from tpusystem.checkpoint import Repository
@@ -102,10 +101,9 @@ class LanguageModel(Aggregate):
     def shard_batches(self, tokens_stack):
         """Place a [steps, batch, ...] stack: batch axis (dim 1) shards
         over (data, fsdp); the steps axis stays whole on every device."""
-        from jax.sharding import NamedSharding, PartitionSpec
-        spec = PartitionSpec(None, *batch_sharding(self.mesh).spec)
+        from tpusystem.parallel import stacked_batch_sharding
         return jax.device_put(tokens_stack,
-                              NamedSharding(self.mesh, spec))
+                              stacked_batch_sharding(self.mesh))
 
     def fit(self, tokens):
         self.state, (_, loss) = self._train_step(self.state, tokens, tokens)

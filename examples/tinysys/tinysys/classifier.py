@@ -73,9 +73,8 @@ class Classifier(Aggregate):
     def shard_batches(self, stacked: tuple) -> tuple:
         """Place [steps, batch, ...] stacks: the batch axis (dim 1)
         shards over (data, fsdp); the steps axis stays whole."""
-        from jax.sharding import NamedSharding, PartitionSpec
-        spec = PartitionSpec(None, *batch_sharding(self.mesh).spec)
-        return tuple(jax.device_put(part, NamedSharding(self.mesh, spec))
+        from tpusystem.parallel import stacked_batch_sharding
+        return tuple(jax.device_put(part, stacked_batch_sharding(self.mesh))
                      for part in stacked)
 
     def fit(self, inputs, targets):

@@ -541,3 +541,29 @@ def test_fused_backward_matches_split(causal):
         for a, b in zip(fused, split):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
+
+
+def test_fused_backward_bf16_partials_stay_f32():
+    """kv_steps > 1 in bf16: the fused backward's dq partials accumulate
+    in float32 before the cross-step sum (bf16 partials would round 4+
+    times per element where the split path rounds once) — fused and split
+    gradients must agree to bf16-roundoff, not worse."""
+    rng = np.random.default_rng(31)
+    shape = (1, 256, 2, 32)
+    q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+               for _ in range(3))
+
+    def loss(backward):
+        def inner(q, k, v):
+            out = flash_attention(q, k, v, causal=True, block_q=64,
+                                  block_kv=64, interpret=True,
+                                  backward=backward)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return inner
+
+    fused = jax.grad(loss('fused'), argnums=(0, 1, 2))(q, k, v)
+    split = jax.grad(loss('split'), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(fused, split):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   atol=2e-2, rtol=2e-2)

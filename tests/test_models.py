@@ -344,9 +344,9 @@ def test_gpt2_scanned_moe_matches_unrolled():
     unrolled = GPT2(**cfg)
     scanned = GPT2(**cfg, scan_layers=True)
     params = unrolled.init(jax.random.PRNGKey(0), tokens)['params']
-    # span i = {d_0: h_{2i} (dense), moe_block: h_{2i+1} (moe)}
-    spans = [{'d_0': params['h_0'], 'moe_block': params['h_1']},
-             {'d_0': params['h_2'], 'moe_block': params['h_3']}]
+    # span i = {d_0: h_{2i} (dense), moe_1: h_{2i+1} (moe)}
+    spans = [{'d_0': params['h_0'], 'moe_1': params['h_1']},
+             {'d_0': params['h_2'], 'moe_1': params['h_3']}]
     stacked = {k: v for k, v in params.items() if not k.startswith('h_')}
     stacked['hs'] = jax.tree.map(lambda *leaves: jnp.stack(leaves), *spans)
     fresh = scanned.init(jax.random.PRNGKey(0), tokens)['params']
@@ -362,5 +362,68 @@ def test_gpt2_scan_layers_moe_needs_divisible_layers():
     from tpusystem.models import GPT2
     module = GPT2(vocab_size=64, layers=3, dim=32, heads=4, max_seq=32,
                   moe_experts=4, moe_every=2, scan_layers=True)
-    with pytest.raises(ValueError, match='divisible by moe_every'):
+    with pytest.raises(ValueError, match='divisible by the span'):
         module.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    bad_unit = GPT2(vocab_size=64, layers=4, dim=32, heads=4, max_seq=32,
+                    moe_experts=4, moe_every=2, scan_layers=True,
+                    scan_unit=3)
+    with pytest.raises(ValueError, match='multiple of moe_every'):
+        bad_unit.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+
+
+@pytest.mark.slow
+def test_gpt2_scanned_moe_with_scan_unit_matches_unrolled():
+    """scan_unit composes with MoE: one span of scan_unit=4 layers carries
+    two (dense, moe) groups — parity with the unrolled stack."""
+    from tpusystem.models import GPT2
+    cfg = dict(vocab_size=64, layers=4, dim=32, heads=4, max_seq=32,
+               dropout=0.0, dtype='float32', moe_experts=4, moe_every=2)
+    tokens = jnp.asarray(np.random.default_rng(10).integers(0, 64, (2, 16)),
+                         jnp.int32)
+    unrolled = GPT2(**cfg)
+    scanned = GPT2(**cfg, scan_layers=True, scan_unit=4)
+    params = unrolled.init(jax.random.PRNGKey(1), tokens)['params']
+    span = {'d_0': params['h_0'], 'moe_1': params['h_1'],
+            'd_2': params['h_2'], 'moe_3': params['h_3']}
+    stacked = {k: v for k, v in params.items() if not k.startswith('h_')}
+    stacked['hs'] = jax.tree.map(lambda leaf: leaf[None], span)
+    fresh = scanned.init(jax.random.PRNGKey(1), tokens)['params']
+    assert jax.tree.structure(fresh) == jax.tree.structure(stacked)
+    logits_u, aux_u = unrolled.apply({'params': params}, tokens)
+    logits_s, aux_s = scanned.apply({'params': stacked}, tokens)
+    np.testing.assert_allclose(np.asarray(logits_u), np.asarray(logits_s),
+                               atol=2e-5)
+    np.testing.assert_allclose(float(aux_u), float(aux_s), rtol=1e-5)
+
+
+@pytest.mark.parametrize('family', ['gpt2', 'llama'])
+def test_scan_unit_groups_match_unrolled(family):
+    """scan_unit=2 groups two blocks per scan step (the workaround for the
+    TPU backend's nested-loop compile cliff): logits must match the
+    unrolled stack given transplanted weights (span i = layers 2i, 2i+1
+    under d_0/d_1)."""
+    from tpusystem.models import gpt2_tiny, llama_tiny
+    if family == 'gpt2':
+        unrolled = gpt2_tiny(layers=4, dtype='float32')
+        scanned = gpt2_tiny(layers=4, scan_layers=True, scan_unit=2,
+                            dtype='float32')
+        prefix, stacked_key = 'h_', 'hs'
+    else:
+        unrolled = llama_tiny(layers=4, dtype='float32')
+        scanned = llama_tiny(layers=4, scan_layers=True, scan_unit=2,
+                             dtype='float32')
+        prefix, stacked_key = 'layer_', 'blocks'
+    tokens = jnp.asarray(np.random.default_rng(15).integers(0, 256, (2, 16)),
+                         jnp.int32)
+    params = unrolled.init(jax.random.PRNGKey(4), tokens)['params']
+    spans = [{'d_0': params[f'{prefix}{2 * i}'],
+              'd_1': params[f'{prefix}{2 * i + 1}']} for i in range(2)]
+    stacked = {k: v for k, v in params.items() if not k.startswith(prefix)}
+    stacked[stacked_key] = jax.tree.map(
+        lambda *leaves: jnp.stack(leaves), *spans)
+    fresh = scanned.init(jax.random.PRNGKey(4), tokens)['params']
+    assert jax.tree.structure(fresh) == jax.tree.structure(stacked)
+    logits_u = unrolled.apply({'params': params}, tokens)
+    logits_s = scanned.apply({'params': stacked}, tokens)
+    np.testing.assert_allclose(np.asarray(logits_u), np.asarray(logits_s),
+                               atol=2e-5)

@@ -21,31 +21,6 @@ from tpusystem.ops.precision import head_logits
 from tpusystem.registry import register
 
 
-def _carry_constraint(mesh):
-    """Sharding pin for the scan-over-layers carry in the TP x FSDP
-    composition: batch over ``data``, hidden dim over ``fsdp``.
-
-    Without a pin, GSPMD gives the scan carry a batch-over-(data, fsdp)
-    layout at the loop boundary while the body's FSDP-scattered weight
-    grads want the carry dim-sharded — an unplannable transition that
-    falls back to an involuntary full rematerialization per layer
-    (spmd_partitioner.cc 'last resort' replicate-then-repartition; the
-    round-3 dryrun warnings). Pinning the carry to P(data, None, fsdp)
-    matches the layout the partitioner itself targets inside the body —
-    measured 2 warnings -> 0 on the 2x2x2 dryrun mesh, identical loss.
-    Meshes without both axes active keep GSPMD's own (already
-    transition-free) choice."""
-    if mesh is None:
-        return lambda hidden: hidden
-    from tpusystem.parallel.mesh import DATA, FSDP, MODEL
-    shape = dict(mesh.shape)
-    if shape.get(FSDP, 1) < 2 or shape.get(MODEL, 1) < 2:
-        return lambda hidden: hidden
-    from jax.sharding import NamedSharding
-    sharding = NamedSharding(mesh, P(DATA, None, FSDP))
-    return lambda hidden: jax.lax.with_sharding_constraint(hidden, sharding)
-
-
 class SelfAttention(nn.Module):
     """Causal multi-head self-attention with a pluggable kernel.
 
@@ -156,13 +131,22 @@ class Block(nn.Module):
 
 
 class BlockSpan(nn.Module):
-    """``span`` consecutive blocks, the last one MoE.
+    """``span`` consecutive blocks; with ``moe_experts > 0`` every
+    ``moe_every``-th block in the span is MoE.
 
-    The homogeneous unit that lets a MoE-every-k stack ride ``nn.scan``:
-    scanning over ``layers/span`` identical spans compiles ONE span body
-    (``span - 1`` dense blocks + 1 MoE block) instead of unrolling the
-    heterogeneous stack. Returns ``(hidden, aux)`` like a MoE
-    :class:`Block`."""
+    The homogeneous unit that lets heterogeneous/deep stacks ride
+    ``nn.scan``: scanning over ``layers/span`` identical spans compiles
+    ONE span body instead of unrolling. Two composable uses:
+
+    * MoE-every-k: ``span`` a multiple of ``moe_every`` — block index
+      ``i`` is MoE iff ``i % moe_every == moe_every - 1`` (params under
+      ``moe_{i}``, dense under ``d_{i}``); returns ``(hidden, aux)`` with
+      ``aux`` the mean router loss of the span's MoE blocks.
+    * ``scan_unit`` grouping (``moe_experts == 0``): k dense layers per
+      scan step keep the scan length under the TPU compiler's
+      nested-loop cliff (an outer steps-loop over a layer-scan longer
+      than ~8 iterations sends the AOT compile from seconds to >10
+      minutes); returns ``hidden`` alone."""
 
     heads: int
     mlp_ratio: int
@@ -176,6 +160,7 @@ class BlockSpan(nn.Module):
     max_seq: int = 1024
     per_row_decode: bool = False
     moe_experts: int = 0
+    moe_every: int = 2
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_exchange: str = 'quota'
@@ -186,17 +171,28 @@ class BlockSpan(nn.Module):
                       attn_dropout=self.attn_dropout, decode=self.decode,
                       max_seq=self.max_seq,
                       per_row_decode=self.per_row_decode)
-        for index in range(self.span - 1):
-            hidden = Block(self.heads, self.mlp_ratio, self.dropout,
-                           self.dtype, name=f'd_{index}',
-                           **common)(hidden, train)
-        hidden, aux = Block(self.heads, self.mlp_ratio, self.dropout,
-                            self.dtype, moe_experts=self.moe_experts,
-                            moe_k=self.moe_k,
-                            moe_capacity_factor=self.moe_capacity_factor,
-                            moe_exchange=self.moe_exchange,
-                            name='moe_block', **common)(hidden, train)
-        return hidden, aux
+        if self.moe_experts and self.span % self.moe_every:
+            raise ValueError(f'span ({self.span}) must be a multiple of '
+                             f'moe_every ({self.moe_every})')
+        aux_terms = []
+        for index in range(self.span):
+            is_moe = (self.moe_experts > 0
+                      and index % self.moe_every == self.moe_every - 1)
+            if is_moe:
+                hidden, aux = Block(
+                    self.heads, self.mlp_ratio, self.dropout, self.dtype,
+                    moe_experts=self.moe_experts, moe_k=self.moe_k,
+                    moe_capacity_factor=self.moe_capacity_factor,
+                    moe_exchange=self.moe_exchange,
+                    name=f'moe_{index}', **common)(hidden, train)
+                aux_terms.append(aux)
+            else:
+                hidden = Block(self.heads, self.mlp_ratio, self.dropout,
+                               self.dtype, name=f'd_{index}',
+                               **common)(hidden, train)
+        if not aux_terms:
+            return hidden
+        return hidden, jnp.mean(jnp.stack(aux_terms))
 
 
 class GPT2(nn.Module):
@@ -222,6 +218,13 @@ class GPT2(nn.Module):
     # instead of `layers` unrolled copies: XLA compiles ONE block body, so
     # compile time stops scaling with depth (the 32-layer 8B unroll is the
     # compile-time cliff); params live under 'hs' with a leading layer dim
+    scan_unit: int = 1  # layers per scan step (scan_layers=True): group k
+    # blocks into one BlockSpan body so the scan length is layers/k — the
+    # TPU backend's nested-loop optimization goes super-linear when an
+    # outer steps-loop wraps a layer-scan longer than ~8 iterations, so
+    # deep stacks inside compiled training loops pick k with
+    # layers/k <= 8 (measured: 12-layer scan in a 90-step loop >10 min
+    # AOT; 6x2 compiles in seconds at identical runtime math)
     return_features: bool = False  # return (features, wte table) for a fused
     # chunked LM loss (train.ChunkedNextTokenLoss) instead of full logits
     decode: bool = False  # KV-cache autoregressive decoding (see
@@ -273,26 +276,51 @@ class GPT2(nn.Module):
                           attn_dropout=self.attn_dropout,
                           decode=self.decode, max_seq=self.max_seq,
                           per_row_decode=self.per_row_decode)
-            constrain = _carry_constraint(self.mesh)
+            from tpusystem.parallel.mesh import scan_carry_constraint
+            constrain = scan_carry_constraint(self.mesh)
             if self.moe_experts:
-                if self.layers % self.moe_every:
+                # span = scan_unit when set (must be a multiple of
+                # moe_every — the MoE pattern repeats inside the span),
+                # else one moe_every group per scan step
+                span_size = (self.scan_unit if self.scan_unit > 1
+                             else self.moe_every)
+                if span_size % self.moe_every:
+                    raise ValueError(
+                        f'scan_unit ({span_size}) must be a multiple of '
+                        f'moe_every ({self.moe_every}) so each scanned '
+                        f'span carries whole MoE groups')
+                if self.layers % span_size:
                     raise ValueError(
                         f'scan_layers with moe_experts needs layers '
-                        f'({self.layers}) divisible by moe_every '
-                        f'({self.moe_every}) — the scan unit is one span '
-                        f'of moe_every blocks')
+                        f'({self.layers}) divisible by the span '
+                        f'({span_size})')
                 span_cls = (nn.remat(BlockSpan, static_argnums=(2,))
                             if self.remat else BlockSpan)
                 template = span_cls(self.heads, self.mlp_ratio,
                                     self.dropout, compute_dtype,
-                                    span=self.moe_every,
+                                    span=span_size,
                                     moe_experts=self.moe_experts,
+                                    moe_every=self.moe_every,
                                     moe_k=self.moe_k,
                                     moe_capacity_factor=self.moe_capacity_factor,
                                     moe_exchange=self.moe_exchange,
                                     name='hs', **common)
-                length = self.layers // self.moe_every
+                length = self.layers // span_size
                 body = lambda block, carry, _: block(constrain(carry), train)
+            elif self.scan_unit > 1:
+                if self.layers % self.scan_unit:
+                    raise ValueError(
+                        f'scan_unit={self.scan_unit} must divide layers '
+                        f'({self.layers})')
+                span_cls = (nn.remat(BlockSpan, static_argnums=(2,))
+                            if self.remat else BlockSpan)
+                template = span_cls(self.heads, self.mlp_ratio,
+                                    self.dropout, compute_dtype,
+                                    span=self.scan_unit, name='hs',
+                                    **common)
+                length = self.layers // self.scan_unit
+                body = lambda block, carry, _: (block(constrain(carry),
+                                                      train), None)
             else:
                 template = block_cls(self.heads, self.mlp_ratio,
                                      self.dropout, compute_dtype,

@@ -175,6 +175,38 @@ class LlamaBlock(nn.Module):
         return hidden + dense(dim, 'down')(gated)
 
 
+class LlamaBlockSpan(nn.Module):
+    """``span`` consecutive LlamaBlocks — the ``scan_unit``
+    grouping that keeps deep scanned stacks under the TPU compiler's
+    nested-loop cliff (see :class:`tpusystem.models.gpt2.BlockSpan`): an
+    outer steps-loop over a layer-scan longer than ~8 iterations sends
+    the AOT compile from seconds to >10 minutes, so the 32-layer 8B scans
+    8 spans of 4."""
+
+    heads: int
+    kv_heads: int
+    ffn_dim: int
+    dtype: jnp.dtype
+    rope_theta: float = 500_000.0
+    span: int = 4
+    attention: str = 'xla'
+    mesh: object = None
+    decode: bool = False
+    max_seq: int = 8192
+    per_row_decode: bool = False
+
+    @nn.compact
+    def __call__(self, hidden, train: bool = False):
+        for index in range(self.span):
+            hidden = LlamaBlock(self.heads, self.kv_heads, self.ffn_dim,
+                                self.dtype, self.rope_theta,
+                                attention=self.attention, mesh=self.mesh,
+                                decode=self.decode, max_seq=self.max_seq,
+                                per_row_decode=self.per_row_decode,
+                                name=f'd_{index}')(hidden, train)
+        return hidden
+
+
 class Llama(nn.Module):
     """Llama-3-style decoder-only transformer.
 
@@ -199,6 +231,10 @@ class Llama(nn.Module):
     # instead of 32 unrolled copies: XLA compiles ONE block body, so 8B
     # compile time stops scaling with depth; params live under 'blocks'
     # with a leading layer dim (see partition_rules)
+    scan_unit: int = 1  # layers per scan step (see gpt2.GPT2.scan_unit):
+    # group k blocks per LlamaBlockSpan so the scan length is layers/k —
+    # keep layers/k <= 8 when the step runs inside a compiled steps-loop
+    # (the TPU backend's nested-loop cliff)
     return_features: bool = False  # return (features, head kernel) for a
     # fused chunked LM loss (train.ChunkedNextTokenLoss); at 128k vocab the
     # full f32 logits tensor is the dominant memory term
@@ -223,20 +259,40 @@ class Llama(nn.Module):
             # O(1) in depth. Decode scans too: the per-layer KV caches ride
             # the scan via variable_axes={'cache': 0} (each layer slice
             # owns its cache at a leading layer dim).
-            template = block_cls(self.heads, self.kv_heads, self.ffn_dim,
-                                 compute_dtype, self.rope_theta,
-                                 attention=self.attention, mesh=self.mesh,
-                                 decode=self.decode, max_seq=self.max_seq,
-                                 per_row_decode=self.per_row_decode,
-                                 name='blocks')
-            from tpusystem.models.gpt2 import _carry_constraint
-            constrain = _carry_constraint(self.mesh)
+            if self.scan_unit > 1:
+                if self.layers % self.scan_unit:
+                    raise ValueError(
+                        f'scan_unit={self.scan_unit} must divide layers '
+                        f'({self.layers})')
+                span_cls = (nn.remat(LlamaBlockSpan, static_argnums=(2,))
+                            if self.remat else LlamaBlockSpan)
+                template = span_cls(self.heads, self.kv_heads,
+                                    self.ffn_dim, compute_dtype,
+                                    self.rope_theta, span=self.scan_unit,
+                                    attention=self.attention,
+                                    mesh=self.mesh, decode=self.decode,
+                                    max_seq=self.max_seq,
+                                    per_row_decode=self.per_row_decode,
+                                    name='blocks')
+                length = self.layers // self.scan_unit
+            else:
+                template = block_cls(self.heads, self.kv_heads,
+                                     self.ffn_dim, compute_dtype,
+                                     self.rope_theta,
+                                     attention=self.attention,
+                                     mesh=self.mesh, decode=self.decode,
+                                     max_seq=self.max_seq,
+                                     per_row_decode=self.per_row_decode,
+                                     name='blocks')
+                length = self.layers
+            from tpusystem.parallel.mesh import scan_carry_constraint
+            constrain = scan_carry_constraint(self.mesh)
             scan = nn.scan(
                 lambda block, carry, _: (block(constrain(carry), train),
                                          None),
                 variable_axes={'params': 0, 'cache': 0},
                 split_rngs={'params': True},
-                length=self.layers)
+                length=length)
             hidden, _ = scan(template, hidden, None)
         else:
             for index in range(self.layers):
@@ -265,10 +321,13 @@ class Llama(nn.Module):
         ``scan_layers`` stacked variant (same splits shifted one dim right
         past the leading layer axis)."""
         return (
-            (r'blocks/attn/(q|k|v)/kernel$', P(None, None, 'model')),
-            (r'blocks/attn/out/kernel$', P(None, 'model', None)),
-            (r'blocks/(gate|up)/kernel$', P(None, None, 'model')),
-            (r'blocks/down/kernel$', P(None, 'model', None)),
+            # `blocks/.*` covers both the plain scanned stack and the
+            # LlamaBlockSpan nesting (blocks/d_0/attn/...) — either way
+            # one leading layer/span dim shifts the spec right
+            (r'blocks/.*attn/(q|k|v)/kernel$', P(None, None, 'model')),
+            (r'blocks/.*attn/out/kernel$', P(None, 'model', None)),
+            (r'blocks/.*(gate|up)/kernel$', P(None, None, 'model')),
+            (r'blocks/.*down/kernel$', P(None, 'model', None)),
             (r'attn/(q|k|v)/kernel$', P(None, 'model')),
             (r'attn/out/kernel$', P('model', None)),
             (r'(gate|up)/kernel$', P(None, 'model')),

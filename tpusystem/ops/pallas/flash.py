@@ -244,6 +244,34 @@ def _flash_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_block_terms(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                     delta_ref, head_row, q_idx, kv_idx,
+                     *, scale, causal, block_q, block_kv, dropout):
+    """The backward block math shared by both fused kernels: recompute
+    scores/probs once and return ``(kept, dscores, query, key, grad_out)``
+    — ``kept`` feeds dv (mask-and-rescaled under dropout), ``dscores``
+    feeds dk and dq. One definition so the GQA partial-array kernel and
+    the MHA resident-dq kernel cannot drift numerically."""
+    query, key, value = q_ref[0], k_ref[0], v_ref[0]
+    grad_out = do_ref[0]
+    scores = _masked_scores(query, key, scale=scale, causal=causal,
+                            q_idx=q_idx, kv_idx=kv_idx,
+                            block_q=block_q, block_kv=block_kv)
+    probs = jnp.exp(scores - lse_ref[0, :, :1])               # (bq, bkv)
+    dprobs = jax.lax.dot_general(
+        grad_out, value, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    if dropout:
+        keep = _keep_mask(seed_ref[0], head_row, q_idx, kv_idx,
+                          block_q, block_kv, dropout)
+        kept = probs * keep / (1.0 - dropout)
+        dprobs = keep * dprobs / (1.0 - dropout)
+    else:
+        kept = probs
+    dscores = probs * (dprobs - delta_ref[0, :, :1]) * scale
+    return kept, dscores, query, key, grad_out
+
+
 def _flash_fused_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                             delta_ref, dq_ref, dk_ref, dv_ref,
                             dk_scr, dv_scr,
@@ -280,26 +308,13 @@ def _flash_fused_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(visible)
     def _block():
-        query, key, value = q_ref[0], k_ref[0], v_ref[0]
-        grad_out = do_ref[0]
-        scores = _masked_scores(query, key, scale=scale, causal=causal,
-                                q_idx=q_idx, kv_idx=kv_idx,
-                                block_q=block_q, block_kv=block_kv)
-        probs = jnp.exp(scores - lse_ref[0, :, :1])           # (bq, bkv)
-        dprobs = jax.lax.dot_general(
-            grad_out, value, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if dropout:
-            keep = _keep_mask(seed_ref[0], head_row, q_idx, kv_idx,
-                              block_q, block_kv, dropout)
-            kept = probs * keep / (1.0 - dropout)
-            dprobs = keep * dprobs / (1.0 - dropout)
-        else:
-            kept = probs
+        kept, dscores, query, key, grad_out = _bwd_block_terms(
+            seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            head_row, q_idx, kv_idx, scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, dropout=dropout)
         dv_scr[...] += jax.lax.dot_general(
             kept.astype(grad_out.dtype), grad_out, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)               # (bkv, d)
-        dscores = probs * (dprobs - delta_ref[0, :, :1]) * scale
         dk_scr[...] += jax.lax.dot_general(
             dscores.astype(query.dtype), query, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -315,6 +330,59 @@ def _flash_fused_bwd_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(jnp.logical_and(head_row % group == group - 1,
                              q_idx == q_steps - 1))
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_fused_bwd_g1_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref,
+                               lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+                               dk_scr, dv_scr,
+                               *, scale: float, causal: bool,
+                               block_q: int, block_kv: int, dropout: float):
+    """Fused backward without the partial-dq array (``group == 1``).
+
+    Grid ``(bh, kv_steps, q_steps)``: for one head row, every (kv, q)
+    block maps to the SAME f32 dq output block ``(1, seq_q, d)``, which
+    Pallas keeps resident in VMEM across the whole row — dq accumulates
+    in place in float32 and is written to HBM once per row (single
+    rounding, zero partial traffic; the ``(kv_steps, ...)`` partial array
+    of :func:`_flash_fused_bwd_kernel` costs ~2% MFU at seq 16k). dk/dv
+    accumulate in scratch across each kv row's q sweep as usual. GQA
+    (group > 1) cannot use this layout — a KV head's dk/dv revisits are
+    non-consecutive when bh is outermost — and keeps the partial-array
+    kernel."""
+    kv_idx, q_idx = pl.program_id(1), pl.program_id(2)
+    head = pl.program_id(0)
+    kv_steps, q_steps = pl.num_programs(1), pl.num_programs(2)
+
+    @pl.when(jnp.logical_and(kv_idx == 0, q_idx == 0))
+    def _init_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    @pl.when(q_idx == 0)
+    def _init_dkv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    @pl.when(_visible(causal, q_idx, kv_idx, block_q, block_kv))
+    def _block():
+        kept, dscores, query, key, grad_out = _bwd_block_terms(
+            seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+            head, q_idx, kv_idx, scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, dropout=dropout)
+        dv_scr[...] += jax.lax.dot_general(
+            kept.astype(grad_out.dtype), grad_out, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # (bkv, d)
+        dk_scr[...] += jax.lax.dot_general(
+            dscores.astype(query.dtype), query, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        rows = pl.ds(q_idx * block_q, block_q)
+        dq_ref[0, rows, :] += jax.lax.dot_general(
+            dscores.astype(key.dtype), key, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == q_steps - 1)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -428,6 +496,50 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
         delta = delta - grad_lse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta, (bh, seq_q, STATS))
 
+    if backward == 'fused' and group == 1 and seq_kv > block_kv:
+        # multi-kv-step MHA: accumulate dq in a resident f32 output block
+        # (no partial array, single rounding — see the kernel docstring).
+        # The whole-row dq block plus the f32 score intermediates exceed
+        # the default scoped-VMEM budget at long seq; raise the limit.
+        kv_steps, q_steps = seq_kv // block_kv, seq_q // block_q
+        kernel = functools.partial(
+            _flash_fused_bwd_g1_kernel, scale=scale, causal=causal,
+            block_q=block_q, block_kv=block_kv, dropout=dropout)
+        seed_args, seed_specs, kernel = _seed_wiring(kernel, seed, dropout)
+        q_row = lambda i, kv, j: (i, j, 0)
+        kv_row = lambda i, kv, j: (i, kv, 0)
+        dq_f32, dk, dv = pl.pallas_call(
+            kernel,
+            grid=(bh, kv_steps, q_steps),
+            in_specs=seed_specs + [
+                pl.BlockSpec((1, block_q, head_dim), q_row),
+                pl.BlockSpec((1, block_kv, head_dim), kv_row),
+                pl.BlockSpec((1, block_kv, head_dim), kv_row),
+                pl.BlockSpec((1, block_q, head_dim), q_row),
+                pl.BlockSpec((1, block_q, STATS), q_row),
+                pl.BlockSpec((1, block_q, STATS), q_row),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, seq_q, head_dim), lambda i, kv, j: (i, 0, 0)),
+                pl.BlockSpec((1, block_kv, head_dim), kv_row),
+                pl.BlockSpec((1, block_kv, head_dim), kv_row),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, seq_q, head_dim), jnp.float32),
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_kv, head_dim), jnp.float32),
+                pltpu.VMEM((block_kv, head_dim), jnp.float32),
+            ],
+            compiler_params=pltpu.CompilerParams(
+                vmem_limit_bytes=96 * 1024 * 1024),
+            interpret=interpret,
+        )(*seed_args, q, k, v, grad_out, lse, delta)
+        dq = dq_f32.astype(q.dtype)
+        return dq, dk, dv, np.zeros(seed.shape, jax.dtypes.float0)
+
     if backward == 'fused':
         kv_steps, q_steps = seq_kv // block_kv, seq_q // block_q
         kernel = functools.partial(
@@ -436,6 +548,11 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
         seed_args, seed_specs, kernel = _seed_wiring(kernel, seed, dropout)
         q_row = lambda kv, i, j: (i, j, 0)
         kv_row = lambda kv, i, j: (i // group, kv, 0)
+        # partials in f32 when they will be summed across kv steps: bf16
+        # rounding before a 16-way sum (seq 16k at 1024 tiles) would make
+        # dq noisier than the split path's f32 scratch accumulation; at
+        # kv_steps == 1 (headline) the sum is a copy and q.dtype is exact
+        partial_dtype = q.dtype if kv_steps == 1 else jnp.float32
         dq_partial, dk, dv = pl.pallas_call(
             kernel,
             grid=(kv_steps, bh, q_steps),
@@ -454,7 +571,8 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
                 pl.BlockSpec((1, block_kv, head_dim), kv_row),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((kv_steps, bh, seq_q, head_dim), q.dtype),
+                jax.ShapeDtypeStruct((kv_steps, bh, seq_q, head_dim),
+                                     partial_dtype),
                 jax.ShapeDtypeStruct(k.shape, k.dtype),
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
             ],
@@ -637,6 +755,8 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
     group = q_heads // kv_heads
     scale = scale if scale is not None else head_dim ** -0.5
 
+    if backward not in ('fused', 'split'):
+        raise ValueError(f"backward must be 'fused' or 'split', got {backward!r}")
     sizes = _block_sizes(seq_q, key.shape[1], block_q, block_kv)
     if sizes is None:
         from tpusystem.ops.attention import repeat_kv_heads
@@ -649,8 +769,6 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
     def to_bh(tensor):  # [B,S,H,D] -> [B*H, S, D]
         return tensor.transpose(0, 2, 1, 3).reshape(-1, tensor.shape[1], head_dim)
 
-    if backward not in ('fused', 'split'):
-        raise ValueError(f"backward must be 'fused' or 'split', got {backward!r}")
     out, lse = _flash_lse(to_bh(query), to_bh(key), to_bh(value), seed,
                           causal, scale, block_q, block_kv, interpret, group,
                           float(dropout), backward)

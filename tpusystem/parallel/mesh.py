@@ -131,5 +131,40 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec((DATA, FSDP)))
 
 
+def scan_carry_constraint(mesh: Mesh | None):
+    """Sharding pin for a scan-over-layers carry ``[batch, seq, dim]``
+    in the TP x FSDP composition: batch over ``data``, hidden dim over
+    ``fsdp``.
+
+    Without a pin, GSPMD gives the scan carry a batch-over-(data, fsdp)
+    layout at the loop boundary while the body's FSDP-scattered weight
+    grads want the carry dim-sharded — an unplannable transition that
+    falls back to an involuntary full rematerialization per layer
+    (spmd_partitioner.cc 'last resort' replicate-then-repartition).
+    Pinning the carry to P(data, None, fsdp) matches the layout the
+    partitioner itself targets inside the body — measured 2 warnings ->
+    0 on a 2x2x2 mesh, identical loss. Returns an identity function for
+    ``mesh=None`` or meshes without both axes active (GSPMD's own choice
+    is already transition-free there). Used by both LM families'
+    ``scan_layers`` paths."""
+    import jax
+
+    if mesh is None:
+        return lambda hidden: hidden
+    shape = dict(mesh.shape)
+    if shape.get(FSDP, 1) < 2 or shape.get(MODEL, 1) < 2:
+        return lambda hidden: hidden
+    sharding = NamedSharding(mesh, PartitionSpec(DATA, None, FSDP))
+    return lambda hidden: jax.lax.with_sharding_constraint(hidden, sharding)
+
+
+def stacked_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for ``[steps, batch, ...]`` stacks (the
+    :func:`tpusystem.train.build_multi_step` input): the steps axis stays
+    whole on every device, the batch axis (dim 1) splits over
+    (data, fsdp) like :func:`batch_sharding`."""
+    return NamedSharding(mesh, PartitionSpec(None, (DATA, FSDP)))
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
