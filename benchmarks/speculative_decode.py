@@ -5,9 +5,14 @@ models measure only the overhead floor (~0.7x: every iteration pays
 K+1 draft steps + 1 verify to emit one token). For a real number, target
 and draft are first TRAINED on the same bigram corpus (SyntheticTokens)
 until they agree on greedy continuations. Exactness caveat: output
-equality with plain decode is bit-exact in float32 (pinned by
-tests/test_generate.py); under bfloat16 argmax tie-breaks may differ
-between the one-token and windowed paths.
+equality with plain decode is bit-exact where matmul numerics are
+window-length invariant — CPU float32 (pinned by tests/test_generate.py)
+and TPU with jax_default_matmul_precision='highest' (verified). At the
+TPU MXU's DEFAULT precision, f32 operands are truncated to bf16 with
+tilings that depend on the query-window length, so the K+1-token verify
+and 1-token decode can flip a near-tie argmax — 'exact match False' on a
+v5e is the platform numeric, not an algorithmic bug (see
+speculative_generate's docstring).
 """
 import sys, time, pathlib
 sys.path.insert(0, str(pathlib.Path(__file__).parent.parent))
@@ -38,7 +43,7 @@ def train(module, steps=300):
 
 target = GPT2(vocab_size=VOCAB, layers=8, dim=512, heads=8, max_seq=512,
               dropout=0.0, dtype='float32')  # f32: decode is overhead-bound
-              # and exact equality with plain decode is then guaranteed
+              # (equality with plain decode: see module docstring)
 draft = GPT2(vocab_size=VOCAB, layers=1, dim=128, heads=2, max_seq=512,
              dropout=0.0, dtype='float32')
 params = train(target)
@@ -61,6 +66,12 @@ for batch in (1, 8):
         spec, spec_tps = timed(lambda: speculative_generate(
             target, params, prompt, steps=STEPS, draft_module=draft,
             draft_params=draft_params, speculate=K), batch * STEPS)
+        # NOT guaranteed True on TPU at DEFAULT matmul precision: the MXU
+        # truncates f32 operands to bf16 with window-length-dependent
+        # tilings, so the K+1-token verify and 1-token decode can flip a
+        # near-tie argmax (~1e-2 logit scatter measured on v5e). Exact
+        # under jax_default_matmul_precision='highest' (verified) and on
+        # CPU — see speculative_generate's docstring.
         exact = bool(np.array_equal(spec, plain))
         print(f'batch={batch} K={K}: plain {plain_tps:.0f} tok/s, '
               f'speculative {spec_tps:.0f} tok/s '

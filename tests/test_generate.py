@@ -271,3 +271,25 @@ def test_generate_on_scanned_model_matches_unrolled(family):
     out_u = generate(unrolled, params, prompt, steps=6)
     out_s = generate(scanned, stacked, prompt, steps=6)
     np.testing.assert_array_equal(np.asarray(out_u), np.asarray(out_s))
+
+
+@pytest.mark.slow
+def test_speculative_decode_on_scanned_target():
+    """Speculative decoding on a scan_layers target: per-row cache cursors
+    live at a leading layer dim (variable_axes={'cache': 0}) and _rewind
+    broadcasts the [batch] cursor into that shape — output must still be
+    exactly the target's greedy decode."""
+    from tpusystem.train import speculative_generate
+    target = gpt2_tiny(dtype='float32', max_seq=128, layers=4,
+                       scan_layers=True)
+    draft = gpt2_tiny(dtype='float32', layers=1, dim=32, heads=2,
+                      max_seq=128)
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(0, 256, (2, 8)), jnp.int32)
+    params = target.init(jax.random.PRNGKey(5), tokens)['params']
+    draft_params = draft.init(jax.random.PRNGKey(6), tokens)['params']
+    reference = np.asarray(generate(target, params, tokens, steps=16))
+    out = speculative_generate(
+        target, params, tokens, steps=16, draft_module=draft,
+        draft_params=draft_params, speculate=3)
+    np.testing.assert_array_equal(np.asarray(out), reference)

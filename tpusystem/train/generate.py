@@ -92,7 +92,15 @@ def speculative_generate(module, params, prompt, *, steps: int,
     tokens, and a bad draft only costs speed, never correctness:
 
     * ``temperature=0``: acceptance is exact match against the target's
-      greedy choices — **output is exactly the target's greedy decode**.
+      greedy choices — **output is exactly the target's greedy decode**
+      in window-length-invariant arithmetic (CPU float32, or TPU with
+      ``jax_default_matmul_precision='highest'``). At the TPU MXU's
+      DEFAULT precision, f32 matmul operands are truncated to bfloat16
+      with tilings that depend on the query-window length, so the
+      verify's K+1-token windows and plain decode's 1-token windows can
+      round a near-tie argmax differently (~1e-2 logit scatter measured
+      on a v5e) — rare content-dependent token flips, each still a
+      greedy choice within platform tolerance.
     * ``temperature>0``: rejection-sampling acceptance (Leviathan et al.):
       draft token ``d`` is accepted with probability ``min(1, p(d)/q(d))``
       and a rejection resamples from ``norm(max(0, p - q))`` — the output
