@@ -115,24 +115,7 @@ def _free_port() -> int:
 @pytest.mark.slow
 @pytest.mark.parametrize('nprocs', [2, 3])
 def test_multi_process_runtime_end_to_end(tmp_path, nprocs):
-    coordinator = f'localhost:{_free_port()}'
-    worker = tmp_path / 'worker.py'
-    worker.write_text(WORKER)
-    env = {**os.environ, 'PYTHONPATH': str(REPO),
-           'TPUSYSTEM_CONTROL': f'localhost:{_free_port()}'}
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), str(rank), str(nprocs), coordinator,
-             str(tmp_path / f'out{rank}.json')],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
-        for rank in range(nprocs)]
-    try:
-        outputs = [proc.communicate(timeout=420)[0].decode() for proc in procs]
-    finally:
-        for proc in procs:   # a hung worker must not outlive the test
-            if proc.poll() is None:
-                proc.kill()
-                proc.wait()
+    procs, outputs = _launch_workers(tmp_path, WORKER, nprocs, timeout=420)
     for proc, output in zip(procs, outputs):
         assert proc.returncode == 0, f'worker failed:\n{output[-3000:]}'
 
@@ -156,3 +139,90 @@ def test_multi_process_runtime_end_to_end(tmp_path, nprocs):
     losses = {record['loss'] for record in records.values()}
     assert len(losses) == 1
     assert records[0]['loss2'] < records[0]['loss']
+
+
+FAILURE_WORKER = r'''
+import json, os, sys, time
+rank, nprocs = int(sys.argv[1]), int(sys.argv[2])
+coordinator, out_path = sys.argv[3], sys.argv[4]
+
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+os.environ['JAX_PLATFORMS'] = 'cpu'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+from tpusystem.parallel.multihost import WorkerLost
+from tpusystem.runtime import Runtime
+from tpusystem.services import Consumer
+
+record = {'rank': rank, 'lost': []}
+runtime = Runtime(coordinator=coordinator, num_processes=nprocs,
+                  process_id=rank, heartbeat=0.5)
+consumer = Consumer()
+consumer.register(WorkerLost, lambda lost: record['lost'].append(lost.rank))
+runtime.producer.register(consumer)
+
+runtime.barrier()                 # everyone up, hub registrations done
+if rank == nprocs - 1:
+    os._exit(1)                   # abrupt death: no 'bye', no cleanup
+
+deadline = time.monotonic() + 30
+while not record['lost'] and time.monotonic() < deadline:
+    runtime.sync()                # drain control-plane events
+    time.sleep(0.05)
+
+with open(out_path, 'w') as handle:
+    json.dump(record, handle)
+    handle.flush()
+    os.fsync(handle.fileno())
+if rank == 0:
+    # rank 0 hosts the hub: linger so the 'lost' fanout reaches every
+    # survivor before os._exit tears the hub thread down mid-broadcast
+    time.sleep(2)
+# skip atexit (jax.distributed shutdown would wait on the dead rank)
+os._exit(0)
+'''
+
+
+def _launch_workers(tmp_path, source: str, nprocs: int, timeout: int):
+    """Spawn ``nprocs`` worker processes from ``source`` sharing one
+    coordinator + control-plane address; returns (procs, outputs) with
+    every process reaped (killed if hung)."""
+    coordinator = f'localhost:{_free_port()}'
+    worker = tmp_path / 'worker.py'
+    worker.write_text(source)
+    env = {**os.environ, 'PYTHONPATH': str(REPO),
+           'TPUSYSTEM_CONTROL': f'localhost:{_free_port()}'}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(rank), str(nprocs), coordinator,
+             str(tmp_path / f'out{rank}.json')],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(nprocs)]
+    try:
+        outputs = [proc.communicate(timeout=timeout)[0].decode()
+                   for proc in procs]
+    finally:
+        for proc in procs:   # a hung worker must not outlive the test
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    return procs, outputs
+
+
+@pytest.mark.slow
+def test_real_process_death_surfaces_worker_lost(tmp_path):
+    """Failure detection over REAL processes: rank N-1 dies abruptly
+    (os._exit — no 'bye' frame, a closed socket like a crashed host);
+    every survivor's control plane must surface a WorkerLost event for
+    exactly that rank. The thread-simulated versions live in
+    tests/test_multihost.py; this is the cross-process proof."""
+    nprocs = 4
+    procs, outputs = _launch_workers(tmp_path, FAILURE_WORKER, nprocs,
+                                     timeout=300)
+    assert procs[nprocs - 1].returncode == 1      # the deliberate death
+    for rank in range(nprocs - 1):
+        assert procs[rank].returncode == 0, (
+            f'survivor {rank} failed:\n{outputs[rank][-3000:]}')
+        record = json.loads((tmp_path / f'out{rank}.json').read_text())
+        assert record['lost'] == [nprocs - 1], record

@@ -1,7 +1,7 @@
 from tpusystem.parallel.mesh import (
     AXES, DATA, EXPERT, FSDP, MODEL, SEQ, STAGE,
     MeshSpec, batch_sharding, force_host_platform, replicated,
-    stacked_batch_sharding,
+    scan_carry_constraint, stacked_batch_sharding,
     single_device_mesh,
 )
 from tpusystem.parallel.multihost import (
@@ -22,7 +22,7 @@ from tpusystem.parallel.sharding import (
 )
 
 __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
-           'stacked_batch_sharding',
+           'scan_carry_constraint', 'stacked_batch_sharding',
            'force_host_platform',
            'ShardingPolicy', 'DataParallel', 'FullyShardedDataParallel',
            'TensorParallel', 'PipelineParallel', 'pipeline_apply', 'pipeline_train',
