@@ -757,6 +757,11 @@ def flash_attention_lse(query, key, value, *, causal: bool = True,
 
     if backward not in ('fused', 'split'):
         raise ValueError(f"backward must be 'fused' or 'split', got {backward!r}")
+    # Tile-size note (measured on v5e, seq 8k-16k MHA): kv-2048 tiles are
+    # 6-9% faster on the isolated fwd+bwd attention chain, but the WHOLE
+    # training step with remat is 2-5% slower (the rematerialized forward
+    # runs twice and loses more at 2048 than the backward gains), so the
+    # 1024/1024 default stands; pass block_kv explicitly to override.
     sizes = _block_sizes(seq_q, key.shape[1], block_q, block_kv)
     if sizes is None:
         from tpusystem.ops.attention import repeat_kv_heads
