@@ -199,11 +199,19 @@ def grouped_batches(loader, size: int):
     consecutive batches — the host-side feeder for
     :func:`build_multi_step`. Accepts loaders yielding tuples (``(inputs,
     targets)``) or bare arrays; the tail stack is shorter when the loader
-    length doesn't divide ``size``."""
+    length doesn't divide ``size``.
+
+    Device-resident batches stack with ``jnp.stack`` (stays on device —
+    ``np.stack`` would round-trip every batch through the host, which on
+    a tunneled TPU costs more than the steps it feeds); host arrays stack
+    with ``np.stack``."""
     group: list = []
 
     def flush():
-        return tuple(np.stack(parts) for parts in zip(*group))
+        return tuple(
+            jnp.stack(parts) if isinstance(parts[0], jax.Array)
+            else np.stack(parts)
+            for parts in zip(*group))
 
     for batch in loader:
         group.append(batch if isinstance(batch, tuple) else (batch,))
