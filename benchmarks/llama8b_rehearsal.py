@@ -186,7 +186,5 @@ if __name__ == '__main__':
         # light enough for XLA:CPU to execute inside its rendezvous window
         virtual(layers or 8, execute=False)
         virtual(layers or 8, ffn=4096)
-    elif False:
-        pass
     else:
         chip(layers or 4, scan='scan' in sys.argv[1:])

@@ -567,3 +567,72 @@ def test_fused_backward_bf16_partials_stay_f32():
         np.testing.assert_allclose(np.asarray(a, np.float32),
                                    np.asarray(b, np.float32),
                                    atol=2e-2, rtol=2e-2)
+
+
+def test_sharded_flash_gqa_broadcast_fallback_warns():
+    """KV heads that don't divide the model axis broadcast up to the query
+    head count — correct, but it forfeits the GQA memory saving, so the
+    fallback must announce itself."""
+    from tpusystem.ops.pallas.flash import sharded_flash_attention
+    rng = np.random.default_rng(13)
+    q = jnp.asarray(rng.normal(size=(2, 64, 4, 32)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 64, 1, 32)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 64, 1, 32)), jnp.float32)
+    mesh = MeshSpec(model=2).build(jax.devices()[:2])
+    reference = dot_product_attention(q, k, v, causal=True)
+    with pytest.warns(UserWarning, match='GQA KV memory saving'):
+        sharded = sharded_flash_attention(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(reference), np.asarray(sharded),
+                               atol=2e-5)
+
+
+def test_fused_backward_vmem_overflow_falls_back_to_split(monkeypatch):
+    """The resident-dq fused variant auto-routes to the split sweeps (with
+    a warning) when its estimated VMEM working set exceeds the requested
+    limit, instead of failing the pallas_call."""
+    from tpusystem.ops.pallas import flash as flash_mod
+    rng = np.random.default_rng(17)
+    shape = (1, 256, 2, 32)                      # MHA: group == 1
+    q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.float32)
+               for _ in range(3))
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, causal=True, block_q=128,
+                              block_kv=128, interpret=True)  # kv_steps = 2
+        return jnp.sum(out ** 2)
+
+    expected = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    monkeypatch.setattr(flash_mod, 'G1_VMEM_LIMIT', 1024)
+    jax.clear_caches()        # drop the cached fused-backward trace
+    with pytest.warns(UserWarning, match='falling back to\n?.*split'):
+        fallback = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(expected, fallback):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_cached_attention_debug_guard_catches_nonuniform_cursor(monkeypatch):
+    """TPUSYSTEM_DEBUG_CACHE=1 turns the per_row=False uniformity contract
+    into a runtime check: a cache whose rows sit at different depths (e.g.
+    left behind by a speculative run) raises instead of silently
+    corrupting every row but row 0."""
+    import flax.linen as nn
+
+    from tpusystem.ops.attention import cached_attention
+
+    class Probe(nn.Module):
+        @nn.compact
+        def __call__(self, q, k, v):
+            return cached_attention(self, q, k, v, max_seq=8, per_row=False)
+
+    rng = np.random.default_rng(19)
+    q = jnp.asarray(rng.normal(size=(2, 1, 2, 16)), jnp.float32)
+    probe = Probe()
+    variables = probe.init(jax.random.PRNGKey(0), q, q, q)
+    cache = dict(variables['cache'])
+    cache['index'] = jnp.asarray([1, 3], jnp.int32)          # non-uniform
+    monkeypatch.setenv('TPUSYSTEM_DEBUG_CACHE', '1')
+    with pytest.raises(Exception, match='uniform cache'):
+        probe.apply({'cache': cache}, q, q, q, mutable=['cache'])
+    # uniform cursor passes the check
+    cache['index'] = jnp.asarray([2, 2], jnp.int32)
+    probe.apply({'cache': cache}, q, q, q, mutable=['cache'])

@@ -86,6 +86,25 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
                      "expected 'xla', 'flash', 'ring' or 'ulysses'")
 
 
+def _debug_cache_enabled() -> bool:
+    """Opt-in runtime verification of decode-cache contracts
+    (``TPUSYSTEM_DEBUG_CACHE=1``); read per trace so tests can flip it."""
+    import os
+    return os.environ.get('TPUSYSTEM_DEBUG_CACHE', '') == '1'
+
+
+def _assert_uniform_cursor(cursor):
+    """Host-side check behind :func:`_debug_cache_enabled`: the
+    ``per_row=False`` fast path writes every row's KV at ``cursor[0]``."""
+    import numpy as np
+    cursor = np.asarray(cursor)
+    if (cursor != cursor[0]).any():
+        raise ValueError(
+            f'cached_attention(per_row=False) requires a uniform cache '
+            f'cursor, got {cursor!r}; pass per_row=True for externally '
+            'managed or speculative cursor state')
+
+
 def cached_attention(module, query, key, value, max_seq: int,
                      per_row: bool = False):
     """Incremental (KV-cache) attention for autoregressive decoding.
@@ -113,7 +132,16 @@ def cached_attention(module, query, key, value, max_seq: int,
     computed-2D-index scatter — on TPU the scatter in the per-token hot
     loop is the slower lowering. The caller owns the uniformity guarantee
     (``tpusystem.train.generate`` passes ``per_row`` only on the
-    speculative path).
+    speculative path): any externally managed cursor state that may
+    diverge per row — e.g. a cache left behind by a speculative run —
+    **must** use ``per_row=True``, or rows whose cursor differs from row
+    0 are silently corrupted. Set ``TPUSYSTEM_DEBUG_CACHE=1`` to verify
+    the contract at runtime: a host callback checks cursor uniformity on
+    every cached step and fails on violation — directly as the
+    ``ValueError`` in eager code, or (inside ``jit``, where callbacks run
+    async) as a callback-failure ``XlaRuntimeError`` at the next sync
+    whose log carries the message. Debug-only — it forces a per-step
+    host transfer.
     """
     batch, length, kv_heads, head_dim = key.shape
     if length > max_seq:
@@ -146,6 +174,8 @@ def cached_attention(module, query, key, value, max_seq: int,
         cache_value.value = cache_value.value.at[rows, positions].set(
             value.astype(cache_value.value.dtype))
     else:
+        if _debug_cache_enabled():
+            jax.debug.callback(_assert_uniform_cursor, cursor)
         # uniform cursor: one dynamic_update_slice writes every row at the
         # shared offset (cursor[0] — the caller's uniformity contract).
         # Past-capacity behavior diverges from the scatter path: the slice

@@ -32,6 +32,7 @@ ship. ``attend(kernel='flash', mesh=...)`` routes there automatically.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +43,9 @@ from jax.experimental.pallas import tpu as pltpu
 from tpusystem.ops.attention import NEG_INF
 
 LANES = 128  # VPU lane count: in-VMEM softmax stats are (block_q, LANES) tiles
+G1_VMEM_LIMIT = 96 * 1024 * 1024  # scoped-VMEM budget requested by the
+             # resident-dq fused backward; past its estimated working set
+             # the backward auto-routes to the split sweeps.
 STATS = 8    # trailing dim of HBM-stored lse/delta — the f32 sublane tile.
              # Mosaic requires the last two block dims divisible by (8, 128) or
              # equal to the array dims, so a compact (bh, seq) layout is not
@@ -484,9 +488,12 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
 
     ``backward``: ``'fused'`` runs the single-pass dq+dk+dv kernel (one
     score recomputation per block — 5 backward matmuls instead of 7);
-    ``'split'`` keeps the separate dq / dkv sweeps (no partial-dq HBM
-    traffic — the A/B reference, and the fallback if the fused kernel's
-    larger VMEM working set cannot tile)."""
+    ``'split'`` keeps the separate dq / dkv sweeps — the manual A/B
+    reference. The resident-dq fused variant (group 1, multi-kv-step)
+    additionally auto-routes to ``'split'`` when its estimated VMEM
+    working set (whole-row f32 dq + block IO + f32 score intermediates)
+    exceeds the 96 MB limit it requests — the one fused layout whose
+    working set grows with ``seq_q`` rather than the block sizes."""
     q, k, v, seed, out, lse = residuals
     bh, seq_q, head_dim = q.shape
     seq_kv = k.shape[1]
@@ -496,7 +503,28 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
         delta = delta - grad_lse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta, (bh, seq_q, STATS))
 
-    if backward == 'fused' and group == 1 and seq_kv > block_kv:
+    resident_dq = backward == 'fused' and group == 1 and seq_kv > block_kv
+    if resident_dq:
+        # Conservative working-set estimate for the resident-dq layout:
+        # whole-row f32 dq, double-buffered input blocks, f32 dk/dv
+        # scratch, and ~3 f32 (block_q, block_kv) score intermediates.
+        # Past the limit requested below, Mosaic would fail the
+        # pallas_call — route to the split sweeps instead (block-sized
+        # working set, independent of seq_q).
+        g1_bytes = (4 * seq_q * head_dim
+                    + 2 * q.dtype.itemsize * (3 * block_q + 2 * block_kv)
+                    * head_dim
+                    + 2 * 4 * block_kv * head_dim
+                    + 3 * 4 * block_q * block_kv)
+        if g1_bytes > G1_VMEM_LIMIT:
+            warnings.warn(
+                f"fused flash backward: estimated VMEM working set "
+                f"{g1_bytes / 2**20:.1f} MB exceeds the "
+                f"{G1_VMEM_LIMIT >> 20} MB limit at this (seq, block) "
+                "combination; falling back to the split dq/dkv sweeps.",
+                stacklevel=2)
+            backward, resident_dq = 'split', False
+    if resident_dq:
         # multi-kv-step MHA: accumulate dq in a resident f32 output block
         # (no partial array, single rounding — see the kernel docstring).
         # The whole-row dq block plus the f32 score intermediates exceed
@@ -534,7 +562,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
                 pltpu.VMEM((block_kv, head_dim), jnp.float32),
             ],
             compiler_params=pltpu.CompilerParams(
-                vmem_limit_bytes=96 * 1024 * 1024),
+                vmem_limit_bytes=G1_VMEM_LIMIT),
             interpret=interpret,
         )(*seed_args, q, k, v, grad_out, lse, delta)
         dq = dq_f32.astype(q.dtype)
@@ -833,6 +861,13 @@ def sharded_flash_attention(query, key, value, mesh, *, causal: bool = True,
     model = shape.get(MODEL, 1)
     head_axis = MODEL if model > 1 and query.shape[2] % model == 0 else None
     if head_axis and key.shape[2] % model:
+        warnings.warn(
+            f"sharded_flash_attention: {key.shape[2]} KV heads do not divide "
+            f"the model axis ({model}); broadcasting KV to the "
+            f"{query.shape[2]} query heads. This is correct but forfeits the "
+            "GQA KV memory saving on this mesh — pick a model axis that "
+            "divides the KV head count to keep grouped KV.",
+            stacklevel=2)
         key, value = repeat_kv_heads(query, key, value)
 
     spec = P(batch_axes or None, None, head_axis, None)

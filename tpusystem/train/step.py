@@ -154,7 +154,10 @@ def build_multi_step(step, *, jit: bool = True, outputs_fn=None):
     overhead (~7 ms through a tunneled-TPU relay; one Python round trip
     anywhere) is paid once per N batches instead of per batch — the
     amortization ``bench.py`` applies that the training service otherwise
-    never gets. Per-phase metrics stay exact: feed the whole loss vector
+    never gets. Each distinct ``N`` compiles its own program (a
+    :func:`grouped_batches` tail group shorter than ``size`` costs one
+    extra compile, cached thereafter). Per-phase metrics stay exact: feed
+    the whole loss vector
     to the accumulator (``Mean``/``Perplexity`` accept arrays), and keep
     events at phase cadence as before.
 
@@ -199,7 +202,12 @@ def grouped_batches(loader, size: int):
     consecutive batches — the host-side feeder for
     :func:`build_multi_step`. Accepts loaders yielding tuples (``(inputs,
     targets)``) or bare arrays; the tail stack is shorter when the loader
-    length doesn't divide ``size``.
+    length doesn't divide ``size``. A shorter tail is a *distinct shape*
+    to the jitted scan in ``build_multi_step`` — it compiles once per
+    distinct group length, so a non-dividing loader pays one extra
+    compile for the remainder group (cached across epochs of the same
+    length; pick ``size`` dividing the epoch, or feed the remainder to
+    the per-batch step, if that compile matters).
 
     Device-resident batches stack with ``jnp.stack`` (stays on device —
     ``np.stack`` would round-trip every batch through the host, which on
