@@ -20,6 +20,18 @@ from tpusystem.ops.attention import attend
 from tpusystem.ops.precision import head_logits
 from tpusystem.registry import register
 
+# Megatron TP splits for one transformer block's leaf paths: qkv/fc split
+# columns on `model`, out/proj split rows (their all-reduce rides ICI).
+# Single source for every layout: GPT2.partition_rules uses them plain and
+# shifted past the `hs/` scan dim; GPT2Pipelined feeds them to
+# PipelineParallel(stacked_rules=...) shifted past the stage dim(s).
+BLOCK_TP_RULES = (
+    (r'attn/qkv/kernel$', P(None, 'model')),
+    (r'attn/out/kernel$', P('model', None)),
+    (r'fc/kernel$', P(None, 'model')),
+    (r'proj/kernel$', P('model', None)),
+)
+
 
 class SelfAttention(nn.Module):
     """Causal multi-head self-attention with a pluggable kernel.
@@ -405,20 +417,15 @@ class GPT2(nn.Module):
             # `hs/.*` covers both the plain scanned stack (hs/attn/...)
             # and BlockSpan nesting (hs/d_0/attn/..., hs/moe_block/attn/...)
             # — either way one leading layer/span dim shifts the spec right
-            (r'hs/.*attn/qkv/kernel$', P(None, None, 'model')),
-            (r'hs/.*attn/out/kernel$', P(None, 'model', None)),
-            (r'hs/.*fc/kernel$', P(None, None, 'model')),
-            (r'hs/.*proj/kernel$', P(None, 'model', None)),
+            *tuple((rf'hs/.*{pattern}', P(None, *spec))
+                   for pattern, spec in BLOCK_TP_RULES),
             # scanned MoE expert stacks: span dim first, then experts
             (r'hs/.*moe/w1$', P(None, EXPERT, None, 'model')),
             (r'hs/.*moe/b1$', P(None, EXPERT, 'model')),
             (r'hs/.*moe/w2$', P(None, EXPERT, 'model', None)),
             (r'hs/.*moe/b2$', P(None, EXPERT, None)),
             (r'hs/.*moe/router$', P()),
-            (r'attn/qkv/kernel$', P(None, 'model')),
-            (r'attn/out/kernel$', P('model', None)),
-            (r'fc/kernel$', P(None, 'model')),
-            (r'proj/kernel$', P('model', None)),
+            *BLOCK_TP_RULES,
             (r'wte/embedding$', P('model', None)),
             (r'wpe/embedding$', P(None, 'model')),
         ) + moe_partition_rules()
@@ -549,15 +556,29 @@ class GPT2Pipelined:
         hidden, _ = jax.lax.scan(layer, hidden, self._flat_stack(params['h']))
         return self._head(params, hidden)
 
+    @staticmethod
+    def block_partition_rules():
+        """Megatron TP rules for the *within-stack* block leaf paths
+        (``attn/qkv/kernel`` etc. — no leading layer dim): qkv/fc split
+        columns on ``model``, out/proj split rows — the same
+        ``BLOCK_TP_RULES`` the non-pipelined family uses. Feed these to
+        ``PipelineParallel(stacked_rules=...)``, which shifts them right
+        past the stage dim(s); the pipeline's partial-manual ``shard_map``
+        then runs each stage's matmuls model-partitioned (PP x TP)."""
+        return BLOCK_TP_RULES
+
     def partition_rules(self):
-        """Stage sharding for the stacked blocks; embeddings/ln replicated
-        (combine with ``fsdp=True`` on the policy to scatter them). With
-        interleave, the chunk-major stack shards its *second* dim (the
-        within-chunk layer index groups ``stages`` contiguous layers per
-        device — see ``pipeline_train``'s layout contract)."""
-        if self.interleave > 1:
-            return ((r'(^|/)h/', P(None, 'stage')),)
-        return ((r'(^|/)h/', P('stage')),)
+        """Stage sharding for the stacked blocks, composed with the
+        Megatron within-stage TP splits (inert on meshes with model=1, or
+        wherever a dim doesn't divide — the policy drops non-dividing
+        axes); embeddings/ln replicated (combine with ``fsdp=True`` on the
+        policy to scatter them). With interleave, the chunk-major stack
+        shards its *second* dim (the within-chunk layer index groups
+        ``stages`` contiguous layers per device — see ``pipeline_train``'s
+        layout contract)."""
+        from tpusystem.parallel.pipeline import compose_stacked_rules
+        return compose_stacked_rules(r'(^|/)h/', self.block_partition_rules(),
+                                     self.interleave)
 
 
 register(GPT2Pipelined, excluded_kwargs={'mesh'})

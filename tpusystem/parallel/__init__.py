@@ -13,8 +13,9 @@ from tpusystem.parallel.collectives import (
     all_gather, all_reduce_mean, all_reduce_sum, all_to_all, axis_index,
     axis_size, reduce_scatter, ring_shift,
 )
-from tpusystem.parallel.pipeline import (PipelineParallel, pipeline_apply,
-                                         pipeline_train)
+from tpusystem.parallel.pipeline import (PipelineParallel,
+                                         compose_stacked_rules,
+                                         pipeline_apply, pipeline_train)
 from tpusystem.parallel.recovery import (LOST_WORKER_EXIT, WorkerLostError,
                                          recovery_consumer)
 from tpusystem.parallel.sharding import (
@@ -25,7 +26,8 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'scan_carry_constraint', 'stacked_batch_sharding',
            'force_host_platform',
            'ShardingPolicy', 'DataParallel', 'FullyShardedDataParallel',
-           'TensorParallel', 'PipelineParallel', 'pipeline_apply', 'pipeline_train',
+           'TensorParallel', 'PipelineParallel', 'compose_stacked_rules',
+           'pipeline_apply', 'pipeline_train',
            'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE',
            'World', 'world', 'connect', 'agree', 'Hub', 'Loopback',
            'ControlPlaneFailover',

@@ -16,8 +16,15 @@ each stage body in ``jax.checkpoint``, so live activations are O(M) *block
 inputs*, not O(M·L) intermediates).
 
 Composition: the batch dimension stays sharded over ``(data, fsdp)``, so
-DP×PP works out of the box. Tensor parallelism *within* a stage is left to
-GSPMD outside the shard_map (a stage body is local by construction).
+DP×PP works out of the box. Tensor parallelism *within* a stage works via
+*partial-manual* ``shard_map``: the pipeline is manual over the
+``(data, fsdp, stage)`` axes only (``axis_names=``), leaving the ``model``
+axis to GSPMD **inside** the stage bodies — stacked params placed
+``P(stage, ..., model)`` (see :func:`PipelineParallel`'s
+``stacked_rules``) keep their model-axis sharding through the shard_map
+boundary, and GSPMD partitions each stage's matmuls over ``model`` with
+the usual Megatron collectives, composed with the manual ``ppermute``
+ring over ``stage``.
 """
 
 from __future__ import annotations
@@ -31,11 +38,62 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from tpusystem.parallel.mesh import DATA, FSDP, STAGE
+from tpusystem.parallel.mesh import DATA, FSDP, MODEL, STAGE
 from tpusystem.parallel.sharding import ShardingPolicy
 
 # One layer of the pipelined stack: (layer_params, activations) -> activations
 BlockFn = Callable[[Any, jax.Array], jax.Array]
+
+
+def _unit_runner(mesh):
+    """How schedule units execute on this mesh: ``run_unit(predicate, run,
+    skip)``.
+
+    Without a live ``model`` axis, idle (fill/drain/pad) ticks *skip* the
+    unit body via ``lax.cond`` — inside shard_map, cond on a
+    device-varying predicate is real per-device control flow. With
+    ``model > 1`` the stage bodies carry GSPMD-inserted model collectives
+    (TP all-reduces, resharding permutes), and a collective may never sit
+    under control flow that only some participants take: devices must
+    issue every collective in lockstep (XLA:CPU's in-process rendezvous
+    deadlocks outright; on any backend non-uniform collective execution
+    is undefined SPMD). So under PP x TP every unit executes *masked* —
+    both paths run, ``jnp.where`` keeps the active one.
+
+    Masked cost: *block* units pay only the fill/drain bubble's worth of
+    extra FLOPs (they were active on ~all non-bubble ticks anyway, and
+    idle devices sit in lockstep either way). The 1F1B *head/tail* units
+    are different: masked, they run on every stage at every round instead
+    of once per microbatch on one stage — up to ~S x redundant head/tail
+    work. Under PP x TP keep the per-tick tail light (chunked/fused loss,
+    ``return_features``) or use the GPipe path (:func:`pipeline_apply`),
+    whose head and tail run outside the pipe entirely."""
+    if mesh.shape.get(MODEL, 1) == 1:
+        return lambda predicate, run, skip: lax.cond(predicate, run, skip)
+
+    def masked(predicate, run, skip):
+        return jax.tree.map(
+            lambda a, b: jnp.where(predicate, a, b), run(), skip())
+    return masked
+
+
+def _manual_axes(mesh) -> frozenset:
+    """Mesh axes the pipeline handles manually inside ``shard_map``.
+
+    With a live ``model`` axis, only the axes whose collectives the
+    schedule issues itself (batch ``psum``, stage ``ppermute``) are
+    manual; ``model`` stays *auto* — GSPMD sees through the shard_map
+    boundary there, so model-axis-sharded stacked params keep their
+    sharding and the stage bodies partition over ``model`` with
+    GSPMD-inserted collectives (Megatron TP within a stage). Partial
+    manualness currently traces only under ``jit`` (eager shard_map
+    rejects it), so the degenerate model=1 mesh keeps the classic fully
+    manual mapping — identical semantics, eager-friendly. Every axis
+    except ``model`` stays manual either way (a block_fn issuing its own
+    seq/expert collectives keeps working under PP x TP)."""
+    if mesh.shape.get(MODEL, 1) == 1:
+        return frozenset(mesh.axis_names)
+    return frozenset(mesh.axis_names) - {MODEL}
 
 
 def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
@@ -92,11 +150,13 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
     stage_body = _stage_scan(block_fn)
     if remat:
         stage_body = jax.checkpoint(stage_body)
+    run_unit = _unit_runner(mesh)
 
     @functools.partial(
         jax.shard_map, mesh=mesh,
         in_specs=(param_specs, activation_spec),
-        out_specs=activation_spec, check_vma=False)
+        out_specs=activation_spec, check_vma=False,
+        axis_names=_manual_axes(mesh))
     def pipelined(params, local_hidden):
         stage = lax.axis_index(STAGE)
         count = lax.axis_size(STAGE)
@@ -130,10 +190,10 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
                 lambda leaf: lax.dynamic_index_in_dim(leaf, c_f, 0,
                                                       keepdims=False),
                 params_all)
-            # idle (fill/drain/pad) ticks skip the block compute: inside
-            # shard_map, cond on a device-varying predicate is real
-            # per-device control flow
-            emitted = lax.cond(active,
+            # idle (fill/drain/pad) ticks skip the block compute (cond —
+            # real per-device control flow inside shard_map) or run it
+            # masked under PP x TP: see _unit_runner
+            emitted = run_unit(active,
                                lambda: stage_body(params_c, x),
                                lambda: jnp.zeros_like(x))
             if count > 1:
@@ -157,6 +217,10 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
         outputs = _broadcast_from_last(outputs, stage, count)
         return outputs.reshape(local_hidden.shape)
 
+    if mesh.shape.get(MODEL, 1) > 1:
+        # partial-manual shard_map only traces under jit (see
+        # _manual_axes); inside an outer jit this inlines to a no-op
+        pipelined = jax.jit(pipelined)
     return pipelined(stacked_params, hidden)
 
 
@@ -327,6 +391,7 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
               else -(-microbatches // stages) * stages)
     rounds = chunks * padded + chunks * stages + stages - 2
     stage_body = _stage_scan(block_fn)
+    run_unit = _unit_runner(mesh)
 
     def step(replicated_params, stacked_params, inputs, targets):
         if inputs.shape[0] % (data_parallel * microbatches):
@@ -341,7 +406,8 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
         @functools.partial(
             jax.shard_map, mesh=mesh, check_vma=False,
             in_specs=(P(), param_specs, batch_spec, batch_spec),
-            out_specs=(P(), (P(), param_specs)))
+            out_specs=(P(), (P(), param_specs)),
+            axis_names=_manual_axes(mesh))
         def run(reps, stacked, local_inputs, local_targets):
             stage = lax.axis_index(STAGE)
             count = stages
@@ -404,11 +470,11 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 # is real per-device control flow: only stage 0 pays for the
                 # embedding, only the last stage for the tail fwd+bwd below,
                 # and fill/drain ticks skip the block unit entirely
-                x = lax.cond((stage == 0) & (c_f == 0),
+                x = run_unit((stage == 0) & (c_f == 0),
                              lambda: head_fn(reps, feed),
                              lambda: carry['fwd_msg'])
                 params_f = chunk_params(stacked, c_f)
-                y = lax.cond(active_f,
+                y = run_unit(active_f,
                              lambda: stage_body(params_f, x),
                              lambda: zero_act)
                 stash = jnp.where(
@@ -433,7 +499,8 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                     return (jnp.float32(0), jax.tree.map(jnp.zeros_like, reps),
                             jnp.zeros_like(y))
 
-                loss_m, d_tail_m, dy = lax.cond(active_t, run_tail, skip_tail)
+                loss_m, d_tail_m, dy = run_unit(active_t, run_tail,
+                                                skip_tail)
                 weight = (jnp.float32(weight_fn(tgt)) if weight_fn
                           else jnp.float32(1.0))
                 # the weight rides the cotangent seed, so every downstream
@@ -466,7 +533,7 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                     return (jax.tree.map(jnp.zeros_like, params_b),
                             jnp.zeros_like(x_saved))
 
-                d_chunk_m, dx = lax.cond(active_b, run_bwd, skip_bwd)
+                d_chunk_m, dx = run_unit(active_b, run_bwd, skip_bwd)
                 if chunks == 1:
                     d_stacked = jax.tree.map(
                         lambda acc, g: acc + g.astype(jnp.float32)[None],
@@ -491,7 +558,7 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                     (d_head_m,) = head_vjp(dx)
                     return d_head_m
 
-                d_head_m = lax.cond(active_h, run_head_vjp,
+                d_head_m = run_unit(active_h, run_head_vjp,
                                     lambda: jax.tree.map(jnp.zeros_like, reps))
                 accumulate = lambda acc_tree, grad_tree, condition: jax.tree.map(
                     lambda acc, g: acc + jnp.where(condition,
@@ -559,22 +626,53 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 carry['d_stacked'], stacked_in)
             return loss, (d_reps, d_stacked)
 
-        return run(replicated_params, stacked_params, inputs, targets)
+        runner = run
+        if mesh.shape.get(MODEL, 1) > 1:
+            # partial-manual shard_map only traces under jit (see
+            # _manual_axes); inside an outer jit this inlines to a no-op
+            runner = jax.jit(run)
+        return runner(replicated_params, stacked_params, inputs, targets)
 
     return step
 
 
 def PipelineParallel(stacked_prefix: str = r'(^|/)h/', extra_rules=(),
-                     fsdp: bool = False, fsdp_min_size: int = 4096,
+                     stacked_rules=(), fsdp: bool = False,
+                     fsdp_min_size: int = 4096,
                      interleave: int = 1) -> ShardingPolicy:
     """Sharding policy for pipelined models: leaves under ``stacked_prefix``
     (the stacked layer collection) shard their leading ``layers`` dimension
     over ``stage``; everything else follows ``extra_rules`` / FSDP.
 
+    ``stacked_rules`` composes Megatron TP *within* stages: ``(pattern,
+    spec)`` pairs matched against the within-stack leaf path (the same
+    per-block rules the non-pipelined family ships, e.g.
+    ``('attn/qkv/kernel$', P(None, 'model'))``); the matched spec is
+    shifted right past the stage dim(s), so a qkv kernel lands on
+    ``P(stage, None, 'model')``. The pipeline's partial-manual
+    ``shard_map`` leaves the ``model`` axis to GSPMD inside stage bodies,
+    which turns these placements into partitioned stage matmuls + TP
+    collectives (see the module docstring). Leaves no stacked rule
+    matches fall back to plain stage sharding.
+
     ``interleave > 1`` matches :func:`pipeline_train`'s chunk-major layout
     (leaves ``[interleave, layers/interleave, ...]``): the *second* dim
     shards over ``stage``, so each device holds its ``interleave``
     non-contiguous chunks without per-step resharding."""
-    spec = P(STAGE) if interleave <= 1 else P(None, STAGE)
-    rules = ((stacked_prefix, spec),) + tuple(extra_rules)
+    rules = compose_stacked_rules(stacked_prefix, stacked_rules, interleave)
+    rules += tuple(extra_rules)
     return ShardingPolicy(rules=rules, fsdp=fsdp, fsdp_min_size=fsdp_min_size)
+
+
+def compose_stacked_rules(stacked_prefix: str, stacked_rules,
+                          interleave: int = 1):
+    """Shift within-stack TP rules past the stage dim(s) and append the
+    plain stage-sharding fallback — the rule set both
+    :func:`PipelineParallel` and the pipelined model families build their
+    policies from. ``stacked_rules`` patterns are ``re.search``-ed against
+    the leaf path, so anchor them to the leaf end (``kernel$``)."""
+    stage_dims = (STAGE,) if interleave <= 1 else (None, STAGE)
+    rules = tuple(
+        (rf'(?:{stacked_prefix}).*(?:{pattern})', P(*stage_dims, *spec))
+        for pattern, spec in stacked_rules)
+    return rules + ((stacked_prefix, P(*stage_dims)),)
