@@ -82,7 +82,7 @@ with Runtime(coordinator=coordinator, num_processes=nprocs, process_id=rank,
     mesh = MeshSpec(data=-1).build()
     module = gpt2_tiny(attention='xla', dtype='float32')
     optimizer = SGD(lr=0.1)
-    tokens = np.random.default_rng(0).integers(0, 256, (12, 32)).astype(np.int32)
+    tokens = np.random.default_rng(0).integers(0, 256, (4 * nprocs, 32)).astype(np.int32)
     state = init_state(module, optimizer, jnp.asarray(tokens[:1]))
     # become global arrays: params replicated, batch sharded over data —
     # each process contributes its local rows of the global batch
@@ -113,8 +113,11 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize('nprocs', [2, 3])
+@pytest.mark.parametrize('nprocs', [2, 3, 8])
 def test_multi_process_runtime_end_to_end(tmp_path, nprocs):
+    """nprocs=8 shakes out hub fan-out + barrier behavior beyond the
+    4-process tier (VERDICT r4 #8): 8 real processes, 16 virtual devices,
+    one DP step over the cross-process mesh."""
     procs, outputs = _launch_workers(tmp_path, WORKER, nprocs, timeout=420)
     for proc, output in zip(procs, outputs):
         assert proc.returncode == 0, f'worker failed:\n{output[-3000:]}'
@@ -301,10 +304,9 @@ def test_multi_process_checkpoint_restart_resume(tmp_path):
     replicated global state), the whole job exits (preemption), and a
     fresh set of processes with the SAME registry identity resumes from
     the last committed epoch and keeps improving the loss."""
-    nprocs = 2
     ckpt_root = tmp_path / 'ckpt'
 
-    def launch(run_dir):
+    def launch(run_dir, nprocs):
         run_dir.mkdir()
         procs, outputs = _launch_workers(run_dir, RESUME_WORKER, nprocs,
                                          timeout=300,
@@ -314,8 +316,12 @@ def test_multi_process_checkpoint_restart_resume(tmp_path):
         return {rank: json.loads((run_dir / f'out{rank}.json').read_text())
                 for rank in range(nprocs)}
 
-    first = launch(tmp_path / 'run1')
-    second = launch(tmp_path / 'run2')
+    # resume on a DIFFERENT topology: the 2-host (4-device) collective
+    # checkpoint restores onto a 3-host (6-device) world — the exact claim
+    # checkpoint/checkpointer.py makes ("resume a v4-8 run on a v4-32"):
+    # orbax restores into the template sharded for the CURRENT mesh
+    first = launch(tmp_path / 'run1', nprocs=2)
+    second = launch(tmp_path / 'run2', nprocs=3)
 
     for records in (first, second):
         identities = {record['identity'] for record in records.values()}

@@ -77,6 +77,21 @@ def _unit_runner(mesh):
     return masked
 
 
+def _needs_jit_wrap(mesh) -> bool:
+    """Partial-manual shard_map (live model axis) only traces under jit.
+    Under an outer jit no wrapper is needed; in eager code we wrap the
+    call in ``jax.jit`` for correctness — note an eager caller then pays
+    a fresh trace per call (the closure is rebuilt each time), so jit
+    the surrounding step for anything hot."""
+    if mesh.shape.get(MODEL, 1) == 1:
+        return False
+    try:
+        from jax._src.core import trace_state_clean
+        return trace_state_clean()
+    except ImportError:       # private API moved: wrap unconditionally
+        return True
+
+
 def _manual_axes(mesh) -> frozenset:
     """Mesh axes the pipeline handles manually inside ``shard_map``.
 
@@ -217,9 +232,7 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
         outputs = _broadcast_from_last(outputs, stage, count)
         return outputs.reshape(local_hidden.shape)
 
-    if mesh.shape.get(MODEL, 1) > 1:
-        # partial-manual shard_map only traces under jit (see
-        # _manual_axes); inside an outer jit this inlines to a no-op
+    if _needs_jit_wrap(mesh):
         pipelined = jax.jit(pipelined)
     return pipelined(stacked_params, hidden)
 
@@ -322,11 +335,17 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
     reduce exactly to classic 1F1B (forward ``r - s``, backward
     ``r - (2S - 2 - s)``).
 
-    Idle units cost (almost) nothing: the head, the tail, *and* each block
-    forward/backward unit sit under ``lax.cond`` — inside ``shard_map``,
-    ``lax.cond`` on a device-varying predicate is real per-device control
-    flow, so fill/drain ticks skip the block compute instead of executing
-    it masked.
+    Idle units cost (almost) nothing *without tensor parallelism*: the
+    head, the tail, and each block forward/backward unit sit under
+    ``lax.cond`` — inside ``shard_map``, ``lax.cond`` on a device-varying
+    predicate is real per-device control flow, so fill/drain ticks skip
+    the block compute instead of executing it masked. With a live
+    ``model`` axis (PP x TP) every unit runs *masked* instead — a
+    GSPMD-inserted model collective cannot sit under control flow only
+    some devices take — so block units pay the bubble's FLOPs and the
+    head/tail run on every stage at every round (up to ~S x redundant
+    head/tail work; keep the per-tick tail light under PP x TP — see
+    :func:`_unit_runner`).
 
     No autodiff runs through the round loop: gradients are accumulated
     explicitly, so ``jax.grad`` of the caller is neither needed nor
@@ -466,10 +485,10 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 active_f, c_f_raw, m_f = schedule(r - stage)
                 c_f = c_f_raw
                 feed = lax.dynamic_index_in_dim(micro_in, m_f, keepdims=False)
-                # inside shard_map, lax.cond on a device-varying predicate
-                # is real per-device control flow: only stage 0 pays for the
-                # embedding, only the last stage for the tail fwd+bwd below,
-                # and fill/drain ticks skip the block unit entirely
+                # run_unit: lax.cond per-device control flow (only stage 0
+                # pays for the embedding, only the last stage for the tail
+                # fwd+bwd, fill/drain ticks skip the block unit) — or
+                # masked lockstep execution under PP x TP (_unit_runner)
                 x = run_unit((stage == 0) & (c_f == 0),
                              lambda: head_fn(reps, feed),
                              lambda: carry['fwd_msg'])
@@ -626,11 +645,7 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 carry['d_stacked'], stacked_in)
             return loss, (d_reps, d_stacked)
 
-        runner = run
-        if mesh.shape.get(MODEL, 1) > 1:
-            # partial-manual shard_map only traces under jit (see
-            # _manual_axes); inside an outer jit this inlines to a no-op
-            runner = jax.jit(run)
+        runner = jax.jit(run) if _needs_jit_wrap(mesh) else run
         return runner(replicated_params, stacked_params, inputs, targets)
 
     return step
