@@ -272,8 +272,8 @@ with Runtime(coordinator=coordinator, num_processes=nprocs, process_id=rank,
     latest = checkpointer.latest(identity)
     record['start_epoch'] = 0 if latest is None else latest
     if latest is not None:
-        # restore lands sharded for the CURRENT global mesh (the restart
-        # may have a different topology; here it matches)
+        # restore lands sharded for the CURRENT global mesh — the test's
+        # second run resumes this 2-host checkpoint on a 3-host world
         state = checkpointer.restore(identity, state, latest)
 
     per_process = tokens.shape[0] // nprocs

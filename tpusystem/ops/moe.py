@@ -224,6 +224,110 @@ def route_top_k_sparse(gates: jax.Array, k: int, capacity: int):
     return token_ids, slots, weights, fraction
 
 
+def _invert_seating(slots, k: int, tokens: int, buffer_rows: int):
+    """Invert the choice-major seating once in integer space (the only
+    scatter in the gather impl — ``buffer_rows`` int32 elements): buffer
+    row -> assignment (``slot_asg``, ``k*tokens`` sentinel for empty),
+    buffer row -> token (``slot_token``, ``tokens`` sentinel;
+    ``token_ids[a] = a % tokens`` by route_top_k_sparse's choice-major
+    layout), and the per-choice ``[k, tokens]`` view of ``slots``. Shared
+    with benchmarks/moe_ceiling.py so the benchmark measures exactly the
+    dispatch MoEMLP executes."""
+    assignments = k * tokens
+    slot_asg = jnp.full((buffer_rows,), assignments,
+                        jnp.int32).at[slots].set(
+        jnp.arange(assignments, dtype=jnp.int32), mode='drop')
+    slot_token = jnp.where(slot_asg < assignments, slot_asg % tokens, tokens)
+    return slot_asg, slot_token, slots.reshape(k, tokens)
+
+
+@jax.custom_vjp
+def _gather_dispatch(flat, slot_token, slots_by_choice):
+    """Scatter-free expert-buffer fill: ``buffer[j] = flat[slot_token[j]]``.
+
+    ``slot_token`` maps each of the ``experts*capacity`` buffer rows to
+    its token (``tokens`` = out-of-range for empty slots, so the gather's
+    ``fill_value=0`` zeroes them); ``slots_by_choice`` is ``[k, tokens]``
+    buffer rows per (choice, token) (``experts*capacity`` when dropped),
+    used only by the backward. Both directions lower to *gathers* plus a
+    k-way sum — on TPU the row-scatter formulation
+    (``buffer.at[slots].set(rows)``) pays the scatter lowering in the
+    forward AND a scatter-add transpose in the backward; this is the
+    same class of fix as round 4's decode cache write (14x)."""
+    return flat.at[slot_token].get(mode='fill', fill_value=0)
+
+
+def _gather_dispatch_fwd(flat, slot_token, slots_by_choice):
+    out = _gather_dispatch(flat, slot_token, slots_by_choice)
+    return out, (slot_token, slots_by_choice)
+
+
+def _gather_dispatch_bwd(residuals, d_buffer):
+    slot_token, slots_by_choice = residuals
+    # d_flat[t] = sum over t's seated choices of d_buffer at that slot:
+    # k gathers (OOB rows of dropped assignments fill 0) + a k-way sum
+    d_flat = sum(d_buffer.at[slots_by_choice[c]].get(mode='fill',
+                                                     fill_value=0)
+                 for c in range(slots_by_choice.shape[0]))
+    zero = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+    return d_flat, zero(slot_token), zero(slots_by_choice)
+
+
+_gather_dispatch.defvjp(_gather_dispatch_fwd, _gather_dispatch_bwd)
+
+
+@jax.custom_vjp
+def _gather_combine(buffer, weights, slots_by_choice, slot_token, slot_asg):
+    """Scatter-free combine: ``out[t] = sum_c w[c,t] * buffer[slot(c,t)]``.
+
+    Replaces the gather + ``at[token_ids].add`` scatter-add of the
+    scatter formulation: ``token_ids`` is ``tile(arange(tokens), k)`` by
+    construction (route_top_k_sparse flattens choice-major), so the
+    scatter-add over it IS a reshape-to-[k, tokens]-and-sum — expressed
+    directly here. Backward: ``d_buffer`` gathers ``d_out`` by
+    ``slot_token`` weighted by the per-slot gate (``weights[slot_asg]``),
+    ``d_weights`` is a rowwise dot of the re-gathered buffer rows with
+    ``d_out`` — gathers only, no scatter in either direction."""
+    k = slots_by_choice.shape[0]
+    compute = buffer.dtype
+    out = None
+    for c in range(k):
+        gathered = buffer.at[slots_by_choice[c]].get(mode='fill',
+                                                     fill_value=0)
+        w = weights.reshape(k, -1)[c][:, None].astype(compute)
+        out = gathered * w if out is None else out + gathered * w
+    return out
+
+
+def _gather_combine_fwd(buffer, weights, slots_by_choice, slot_token,
+                        slot_asg):
+    out = _gather_combine(buffer, weights, slots_by_choice, slot_token,
+                          slot_asg)
+    return out, (buffer, weights, slots_by_choice, slot_token, slot_asg)
+
+
+def _gather_combine_bwd(residuals, d_out):
+    buffer, weights, slots_by_choice, slot_token, slot_asg = residuals
+    k = slots_by_choice.shape[0]
+    compute = buffer.dtype
+    w_slot = weights.at[slot_asg].get(mode='fill', fill_value=0)
+    d_buffer = (w_slot[:, None].astype(compute)
+                * d_out.at[slot_token].get(mode='fill', fill_value=0))
+    d_w = []
+    for c in range(k):
+        gathered = buffer.at[slots_by_choice[c]].get(mode='fill',
+                                                     fill_value=0)
+        d_w.append(jnp.sum(gathered.astype(jnp.float32)
+                           * d_out.astype(jnp.float32), axis=-1))
+    d_weights = jnp.concatenate(d_w).astype(weights.dtype)
+    zero = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+    return (d_buffer, d_weights, zero(slots_by_choice), zero(slot_token),
+            zero(slot_asg))
+
+
+_gather_combine.defvjp(_gather_combine_fwd, _gather_combine_bwd)
+
+
 class MoEMLP(nn.Module):
     """Expert-parallel FFN: drop-in for the dense fc->gelu->proj block.
 
@@ -264,6 +368,12 @@ class MoEMLP(nn.Module):
     # same seating semantics over an all_gather transport for backends
     # whose XLA cannot lower ragged-all-to-all (CPU test/virtual meshes)
     exchange: str = 'quota'
+    # single-shard sparse data movement: 'gather' routes dispatch+combine
+    # through the scatter-free custom_vjp pair (_gather_dispatch /
+    # _gather_combine — gathers + k-way sums in both directions, one tiny
+    # int scatter to invert the seating); 'scatter' is the row-scatter
+    # formulation (the A/B reference; benchmarks/moe_ceiling.py)
+    sparse_impl: str = 'gather'
 
     @nn.compact
     def __call__(self, hidden):
@@ -328,11 +438,20 @@ class MoEMLP(nn.Module):
                                    self.capacity_factor)
 
         if mode == 'sparse':
+            if self.sparse_impl not in ('gather', 'scatter'):
+                raise ValueError(f'unknown sparse_impl {self.sparse_impl!r}; '
+                                 "expected 'gather' or 'scatter'")
             token_ids, slots, weights, fraction = route_top_k_sparse(
                 gates, self.k, capacity)
-            rows = flat.astype(compute)[token_ids]     # [k*N, D] gather
-            expert_in = jnp.zeros((self.experts * capacity, dim), compute)
-            expert_in = expert_in.at[slots].set(rows, mode='drop')
+            if self.sparse_impl == 'gather':
+                slot_asg, slot_token, slots_by_choice = _invert_seating(
+                    slots, self.k, tokens, self.experts * capacity)
+                expert_in = _gather_dispatch(flat.astype(compute),
+                                             slot_token, slots_by_choice)
+            else:
+                rows = flat.astype(compute)[token_ids]     # [k*N, D] gather
+                expert_in = jnp.zeros((self.experts * capacity, dim), compute)
+                expert_in = expert_in.at[slots].set(rows, mode='drop')
             expert_in = expert_in.reshape(self.experts, capacity, dim)
         else:
             dispatch, combine, fraction = route_top_k(gates, self.k, capacity)
@@ -350,8 +469,12 @@ class MoEMLP(nn.Module):
 
         if mode == 'sparse':
             buffer = shrunk.reshape(self.experts * capacity, dim)
-            output = self._sparse_combine(buffer, slots, token_ids, weights,
-                                          tokens, dim, compute)
+            if self.sparse_impl == 'gather':
+                output = _gather_combine(buffer, weights, slots_by_choice,
+                                         slot_token, slot_asg)
+            else:
+                output = self._sparse_combine(buffer, slots, token_ids,
+                                              weights, tokens, dim, compute)
         else:
             output = jnp.einsum('nec,ecd->nd', combine.astype(compute), shrunk)
         return output.reshape(*batch_shape, dim).astype(hidden.dtype), aux

@@ -78,18 +78,14 @@ def _unit_runner(mesh):
 
 
 def _needs_jit_wrap(mesh) -> bool:
-    """Partial-manual shard_map (live model axis) only traces under jit.
-    Under an outer jit no wrapper is needed; in eager code we wrap the
-    call in ``jax.jit`` for correctness — note an eager caller then pays
-    a fresh trace per call (the closure is rebuilt each time), so jit
-    the surrounding step for anything hot."""
-    if mesh.shape.get(MODEL, 1) == 1:
-        return False
-    try:
-        from jax._src.core import trace_state_clean
-        return trace_state_clean()
-    except ImportError:       # private API moved: wrap unconditionally
-        return True
+    """Partial-manual shard_map (live model axis) only traces under jit,
+    so PP x TP calls are wrapped unconditionally — a non-jit trace
+    context (eager ``jax.grad``, ``vmap``, ``eval_shape``) needs the
+    wrapper just as plain eager execution does, and under an outer jit
+    the nested jit is cheap. Note an *eager* caller pays a fresh trace
+    per call (the closure is rebuilt each time): jit the surrounding
+    step for anything hot."""
+    return mesh.shape.get(MODEL, 1) > 1
 
 
 def _manual_axes(mesh) -> frozenset:
