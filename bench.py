@@ -29,6 +29,16 @@ PEAKS = {
 }
 
 
+def materialize(tree) -> None:
+    """Force completion with a host read. On the tunneled platform
+    ``jax.block_until_ready`` returns before the computation finishes
+    (it reported 'impossible' microsecond steps); transferring a scalar
+    to the host is the only reliable fence — every benchmark in this
+    repo times with this."""
+    leaf = jax.tree.leaves(tree)[0]
+    float(jnp.sum(leaf.astype(jnp.float32)))
+
+
 def peak_flops(device) -> float | None:
     kind = device.device_kind.lower()
     for key, value in PEAKS.items():

@@ -1,0 +1,158 @@
+"""Decode roofline: what does one greedy-decode token-step *have* to cost?
+
+BASELINE's decode row (GPT-2 125M, batch 8, prefill 128, decode 128) is
+2310 tok/s = 3.46 ms per token-step on 1x v5e. This file writes the
+weight-streaming roofline next to it and decomposes the gap:
+
+1. ``bandwidth``   — big-copy effective HBM bandwidth of the chip
+2. ``stream_f32``  — the exact decode matmul chain (12 layers qkv/out/
+                     fc/proj + LM head) with float32 master weights, the
+                     layout ``generate()`` historically streamed
+3. ``stream_bf16`` — identical chain with pre-cast bfloat16 weights
+                     (identical matmul numerics — the bf16 cast happens
+                     per-use anyway; only the HBM bytes halve)
+4. ``generate``    — the real ``generate()`` under both streaming modes
+
+Roofline: 125M params x 4 B (f32) = ~500 MB/step → ~0.61 ms at the v5e's
+~819 GB/s; bf16 halves it to ~0.31 ms. The measured chain vs the
+measured copy bandwidth separates "medium-matmul streaming is below
+copy bandwidth" (platform) from "the decode loop adds overhead on top"
+(framework).
+
+Run: ``python benchmarks/decode_roofline.py``
+"""
+
+from __future__ import annotations
+
+import sys
+sys.path.insert(0, str(__import__('pathlib').Path(__file__).parent.parent))
+
+import json
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import materialize as _materialize
+
+BATCH, DIM, LAYERS, VOCAB = 8, 768, 12, 50304
+REPS = 200
+
+
+def _time(run, *args) -> float:
+    out = run(*args)
+    _materialize(out)
+    t0 = time.perf_counter()
+    out = run(*args)
+    _materialize(out)
+    return (time.perf_counter() - t0) / REPS
+
+
+# v5e paper HBM bandwidth. Measured probes mislead here: a fori_loop of
+# per-slice reductions reports 20 GB/s (loop overhead) and one giant
+# fused multiply-reduce reports 33 GB/s (reduction lowering), while the
+# decode matmul chain itself sustains ~280 GB/s — the matmul chain IS
+# the honest streaming measurement; the paper number anchors the floor.
+PAPER_HBM_GBS = 819.0
+
+
+def stream_chain(dtype) -> tuple[float, int]:
+    """ms per step of the exact decode matmul chain, weights in ``dtype``
+    (cast to bf16 per use, as the model's Dense layers do)."""
+    rng = np.random.default_rng(0)
+    layers = []
+    for _ in range(LAYERS):
+        layers.append(tuple(
+            jnp.asarray(rng.normal(size=shape) * 0.02, dtype)
+            for shape in [(DIM, 3 * DIM), (DIM, DIM), (DIM, 4 * DIM),
+                          (4 * DIM, DIM)]))
+    head = jnp.asarray(rng.normal(size=(DIM, VOCAB)) * 0.02, dtype)
+    x0 = jnp.asarray(rng.normal(size=(BATCH, DIM)), jnp.bfloat16)
+    nbytes = (sum(w.nbytes for ws in layers for w in ws) + head.nbytes)
+
+    @jax.jit
+    def run(x0, layers, head):
+        def step(carry, _):
+            x = carry
+            for qkv, out, fc, proj in layers:
+                h = x @ qkv.astype(jnp.bfloat16)
+                x = x + h[:, :DIM] @ out.astype(jnp.bfloat16)
+                g = jax.nn.gelu(x @ fc.astype(jnp.bfloat16))
+                x = x + g @ proj.astype(jnp.bfloat16)
+            logits = x @ head.astype(jnp.bfloat16)
+            # argmax feedback: the next step depends on this one (no
+            # hoisting), like real greedy decode
+            x = x0 + (jnp.argmax(logits, -1)[:, None] % 7).astype(jnp.bfloat16) * 1e-3
+            return x, logits[0, 0]
+        _, ys = jax.lax.scan(step, x0, None, length=REPS)
+        return ys
+
+    return _time(run, x0, tuple(layers), head) * 1e3, nbytes
+
+
+def measured_generate(stream_dtype: str) -> float:
+    """tok/s of the real generate() at the BASELINE row's config."""
+    from tpusystem.models import GPT2
+    from tpusystem.train.generate import generate
+
+    module = GPT2(dropout=0.0, vocab_size=VOCAB, max_seq=512)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, VOCAB, (BATCH, 128)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), prompt[:1, :8])['params']
+
+    out = generate(module, params, prompt, steps=128,
+                   stream_dtype=stream_dtype)
+    np.asarray(out)
+    t0 = time.perf_counter()
+    out = generate(module, params, prompt, steps=128,
+                   stream_dtype=stream_dtype)
+    np.asarray(out)
+    elapsed = time.perf_counter() - t0
+    return BATCH * 128 / elapsed
+
+
+def scaling() -> None:
+    """tok/s vs cache capacity (bucketed reads) and batch (weight-stream
+    amortization) — the two levers the roofline exposes."""
+    from tpusystem.models import GPT2
+    from tpusystem.train.generate import generate
+
+    for batch, max_seq in [(8, 256), (8, 512), (8, 1024), (32, 512),
+                           (64, 512)]:
+        module = GPT2(dropout=0.0, vocab_size=VOCAB, max_seq=max_seq)
+        rng = np.random.default_rng(0)
+        prompt = jnp.asarray(rng.integers(0, VOCAB, (batch, 128)), jnp.int32)
+        params = module.init(jax.random.PRNGKey(0), prompt[:1, :8])['params']
+        out = generate(module, params, prompt, steps=128)
+        np.asarray(out)
+        t0 = time.perf_counter()
+        out = generate(module, params, prompt, steps=128)
+        np.asarray(out)
+        elapsed = time.perf_counter() - t0
+        print(json.dumps({'batch': batch, 'max_seq': max_seq,
+                          'tok_per_s': round(batch * 128 / elapsed),
+                          'ms_per_step': round(elapsed / 128 * 1e3, 3)}))
+
+
+def main() -> None:
+    for dtype, tag in [(jnp.float32, 'f32'), (jnp.bfloat16, 'bf16')]:
+        ms, nbytes = stream_chain(dtype)
+        floor = nbytes / (PAPER_HBM_GBS * 1e9) * 1e3
+        print(json.dumps({
+            f'stream_{tag}': {'ms_per_step': round(ms, 3),
+                              'weight_mb': round(nbytes / 2**20),
+                              'effective_gbs': round(nbytes / ms * 1e-6, 1),
+                              'paper_bw_floor_ms': round(floor, 3),
+                              'vs_floor': round(ms / floor, 2)}}))
+    for mode in ('float32', 'auto'):
+        tok = measured_generate(mode)
+        print(json.dumps({f'generate[{mode}]': {
+            'tok_per_s': round(tok),
+            'ms_per_token_step': round(BATCH * 1e3 / tok, 3)}}))
+    scaling()
+
+
+if __name__ == '__main__':
+    main()

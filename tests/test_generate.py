@@ -293,3 +293,32 @@ def test_speculative_decode_on_scanned_target():
         target, params, tokens, steps=16, draft_module=draft,
         draft_params=draft_params, speculate=3)
     np.testing.assert_array_equal(np.asarray(out), reference)
+
+
+def test_stream_dtype_auto_matches_f32_streaming_exactly():
+    """For a bf16-compute model, pre-casting f32 matrix masters to bf16
+    (stream_dtype='auto') must produce bit-identical generations to
+    streaming the f32 masters: the model casts weights to bf16 at every
+    use anyway, so only the HBM bytes change (the decode bandwidth
+    optimization — see BASELINE.md decode roofline)."""
+    module = gpt2_tiny(dtype='bfloat16')
+    prompt = jnp.asarray(
+        np.random.default_rng(23).integers(0, 256, (2, 8)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    auto = generate(module, params, prompt, steps=12)
+    f32 = generate(module, params, prompt, steps=12, stream_dtype='float32')
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(f32))
+
+
+@pytest.mark.slow
+def test_bucketed_cache_attention_crosses_bucket_boundary():
+    """max_seq 512 decode buckets cache reads at [256, 512]; a generation
+    crossing the 256-token boundary must stay token-exact with the full
+    re-forward reference (the switch picks a wider window mid-scan)."""
+    module = gpt2_tiny(dtype='float32', max_seq=512)
+    prompt = jnp.asarray(
+        np.random.default_rng(29).integers(0, 256, (2, 250)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(1), prompt[:, :8])['params']
+    decoded = generate(module, params, prompt, steps=20)   # 250 -> 270
+    reference = full_forward_greedy(module, params, prompt, 20)
+    np.testing.assert_array_equal(np.asarray(decoded), np.asarray(reference))

@@ -200,12 +200,33 @@ def cached_attention(module, query, key, value, max_seq: int,
             from tpusystem.ops.pallas.flash import flash_attention
             return flash_attention(query, key, value, causal=True)
         return dot_product_attention(query, key, value, causal=True)
-    # attend causally over the filled prefix, per row:
-    # key position <= row cursor + query offset
-    mask = (jnp.arange(max_seq)[None, None, :]
-            <= positions[:, :, None])                      # [B, L, S]
-    return dot_product_attention(query, cache_key.value, cache_value.value,
-                                 causal=False, mask=mask[:, None])
+    # attend causally over the filled prefix, per row (key position <=
+    # row cursor + query offset). The cache is allocated max_seq wide,
+    # but reading all of it every step makes decode cost scale with
+    # *capacity*, not fill: at 125M/batch 8 the full-width read is ~2.3
+    # of the 3.4 ms step at max_seq 1024 (benchmarks/decode_roofline.py).
+    # Bucketed attention reads only the smallest power-of-2 window
+    # covering the filled prefix — lax.switch over static slice widths,
+    # so shapes stay static per branch inside one compiled program.
+    def attend_over(width: int):
+        def run():
+            keys = jax.lax.slice_in_dim(cache_key.value, 0, width, axis=1)
+            values = jax.lax.slice_in_dim(cache_value.value, 0, width, axis=1)
+            mask = (jnp.arange(width)[None, None, :]
+                    <= positions[:, :, None])              # [B, L, W]
+            return dot_product_attention(query, keys, values,
+                                         causal=False, mask=mask[:, None])
+        return run
+
+    buckets = [256]
+    while buckets[-1] < max_seq:
+        buckets.append(min(2 * buckets[-1], max_seq))
+    if len(buckets) == 1:
+        return attend_over(max_seq)()
+    filled = jnp.max(positions) + 1
+    index = sum((filled > width).astype(jnp.int32)
+                for width in buckets[:-1])
+    return jax.lax.switch(index, [attend_over(w) for w in buckets])
 
 
 def dot_product_attention(query, key, value, *, causal: bool = True,

@@ -39,6 +39,46 @@ def _decoder(module, per_row: bool = False):
     return dataclasses.replace(module, **updates)
 
 
+def _stream_params(decoder, params, stream_dtype: str):
+    """Pre-cast f32 matrix leaves to the decode compute dtype (see
+    ``generate``'s ``stream_dtype``). No-op for f32-compute modules."""
+    if stream_dtype == 'float32':
+        return params
+    if stream_dtype != 'auto':
+        raise ValueError(f'unknown stream_dtype {stream_dtype!r}; '
+                         "expected 'auto' or 'float32'")
+    compute = jnp.dtype(getattr(decoder, 'dtype', jnp.float32))
+    if compute.itemsize >= jnp.dtype(jnp.float32).itemsize:
+        return params
+
+    return _caster(compute.name)(params)
+
+
+@functools.cache
+def _caster(compute_name: str):
+    """One cached jitted cast program per target dtype: per-leaf eager
+    casts would pay a host dispatch each (~60 relay round-trips per
+    generate() call), and an uncached jit would *retrace and recompile*
+    the cast every call (measured 8x slower decode)."""
+    compute = jnp.dtype(compute_name)
+
+    def cast(path, leaf):
+        # leaves the model consumes at f32 must stay f32: embedding
+        # tables (the embed step ADDS wte+wpe rows in f32 before
+        # casting; the scan-hoisted head cast keeps the head matmul
+        # bf16 anyway) and MoE routers (gate logits are an f32 matmul —
+        # a bf16-rounded router could flip near-tie expert choices)
+        from tpusystem.parallel.sharding import leaf_path
+        path = leaf_path(path)
+        if 'embedding' in path or 'router' in path:
+            return leaf
+        if leaf.ndim >= 2 and leaf.dtype == jnp.float32:
+            return leaf.astype(compute)
+        return leaf
+
+    return jax.jit(functools.partial(jax.tree_util.tree_map_with_path, cast))
+
+
 def _sample(logits, temperature: float, rng):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -47,7 +87,8 @@ def _sample(logits, temperature: float, rng):
 
 
 def generate(module, params, prompt, *, steps: int,
-             temperature: float = 0.0, rng=None):
+             temperature: float = 0.0, rng=None,
+             stream_dtype: str = 'auto'):
     """Generate ``steps`` tokens after ``prompt``.
 
     Args:
@@ -57,6 +98,18 @@ def generate(module, params, prompt, *, steps: int,
         steps: tokens to generate per sequence.
         temperature: 0 = greedy argmax; otherwise categorical sampling.
         rng: ``jax.random`` key (required when ``temperature > 0``).
+        stream_dtype: ``'auto'`` (default) pre-casts float32 matrix
+            kernels (ndim >= 2) to the module's compute dtype when that
+            dtype is narrower. Decode at small batch is weight-STREAMING
+            bound, and a bf16-compute model casts its f32 kernels to
+            bf16 at every use anyway — the cast changes which bytes a
+            decode-only process keeps resident, not the matmul numerics.
+            Leaves the model consumes at f32 are NOT cast: embedding
+            tables (the embed step adds wte+wpe rows in f32 — for GPT-2
+            the tied table is the part whose footprint does not halve),
+            MoE router weights (routing runs in f32), and vector leaves
+            (biases, layernorm scales). ``'float32'`` streams the
+            masters untouched (the training layout).
 
     Returns:
         int32 ``[batch, prompt_len + steps]`` — prompt plus generation.
@@ -67,6 +120,7 @@ def generate(module, params, prompt, *, steps: int,
         raise ValueError('temperature sampling needs an rng key')
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     decoder = _decoder(module)
+    params = _stream_params(decoder, params, stream_dtype)
     if prompt.shape[1] + steps > decoder.max_seq:
         raise ValueError(
             f'prompt ({prompt.shape[1]}) + steps ({steps}) exceeds the '
