@@ -1,7 +1,13 @@
 """Headline benchmark: GPT-2 125M training MFU on one chip.
 
 Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+``{"metric": ..., "value": N, "spread": N, "unit": ..., "vs_baseline": N}``.
+
+``value`` is the **median of TRIALS (>= 3) timed runs** after a shared
+warmup/compile, and ``spread`` is the max-min range across those runs —
+so a BENCH_r* delta can be told from the sweep's own run-to-run noise
+(round 5 measured +-0.006 MFU between identical runs; a single sample
+cannot distinguish a real 1% regression from that).
 
 The reference publishes no numbers (BASELINE.md); ``vs_baseline`` is
 measured MFU against the north-star target of 0.50 MFU (BASELINE.json).
@@ -18,6 +24,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+TRIALS = 3   # timed runs per report (median printed, max-min as spread)
 
 # bf16 peak FLOP/s per chip by device kind substring
 PEAKS = {
@@ -89,10 +97,15 @@ def main() -> None:
     state = run(state, tokens)
     float(jax.tree.leaves(state.params)[0].sum())
 
-    start = time.perf_counter()
-    state = run(state, tokens)
-    float(jax.tree.leaves(state.params)[0].sum())
-    elapsed = time.perf_counter() - start
+    # median-of-TRIALS with the max-min range: BENCH_r* deltas smaller
+    # than the printed spread are the sweep's own noise, not a change
+    elapsed_trials = []
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        state = run(state, tokens)
+        float(jax.tree.leaves(state.params)[0].sum())
+        elapsed_trials.append(time.perf_counter() - start)
+    elapsed = sorted(elapsed_trials)[len(elapsed_trials) // 2]
 
     tokens_per_step = batch * seq
     head_dim = module.dim // module.heads
@@ -105,17 +118,23 @@ def main() -> None:
     device = jax.devices()[0]
     peak = peak_flops(device)
     if peak:
+        to_mfu = lambda secs: step_flops * steps / secs / peak
         mfu = achieved / peak
         print(json.dumps({
             'metric': 'gpt2_125m_train_mfu_1chip',
             'value': round(mfu, 4),
+            'spread': round(to_mfu(min(elapsed_trials))
+                            - to_mfu(max(elapsed_trials)), 4),
             'unit': 'MFU',
             'vs_baseline': round(mfu / 0.5, 4),
         }))
     else:  # CPU fallback: report throughput
+        to_sps = lambda secs: steps / secs
         print(json.dumps({
             'metric': 'gpt2_125m_train_steps_per_sec_cpu',
             'value': round(steps / elapsed, 4),
+            'spread': round(to_sps(min(elapsed_trials))
+                            - to_sps(max(elapsed_trials)), 4),
             'unit': 'steps/s',
             'vs_baseline': 1.0,
         }))

@@ -14,11 +14,16 @@ any gradient is hoisted or dead-code-eliminated):
   dispatch   token-row gather + scatter into the [E*C, D] expert buffer
   expert_ffn the per-expert ecd,edh/ech,ehd einsum pair (the MXU work)
   combine    buffer gather + weighted scatter-add back to token order
-  moe_layer  the full MoEMLP (sum of the above + glue)
+  fused      the Pallas grouped gather-matmul pair: dispatch riding the
+             up-projection's loads, the weighted combine riding the
+             down-projection's epilogue (no standalone row movement)
+  moe_layer  the full MoEMLP, all three sparse impls
+             (scatter / gather / fused — the three-way table)
   dense_ffn  the fc/gelu/proj block at the same token count (reference)
 
-`python benchmarks/moe_ceiling.py [whole]` — `whole` additionally
-re-measures the end-to-end 323M-param train step (the BASELINE row).
+`python benchmarks/moe_ceiling.py [whole [scatter|gather|fused]]` —
+`whole` additionally re-measures the end-to-end 323M-param train step
+(the BASELINE row) with the chosen single-shard row movement.
 
 Accounting note: active-MFU charges k=2 experts' FLOPs per token, but
 the capacity-factor buffer executes k*cf = 2.5 experts' worth — the FFN
@@ -224,9 +229,45 @@ def phases() -> None:
                      buffer, weights),
         note='k gathers + weighted sum; bwd gathers only')
 
-    # --- whole MoE layer, both impls ------------------------------------
+    # --- fused kernel phases: the data movement rides the matmuls -------
+    # (forward-only rows: the kernels' backwards ARE the same kernels with
+    # swapped operands, measured through moe_layer[fused] below. MFU here
+    # charges the executed matmul FLOPs — compare dispatch[gather] +
+    # half of expert_ffn against dispatch+up[fused]. Seating arrays are
+    # the slot_asg/slot_token/slots_by_choice computed above, so the
+    # fused rows measure exactly the seating the gather rows measure.)
+    from tpusystem.ops.pallas.grouped_matmul import (gather_rows_matmul,
+                                                     matmul_scatter_rows)
+
+    clamped = jnp.minimum(slot_token, TOKENS - 1)
+    valid = (slot_token < TOKENS).astype(jnp.float32)
+    w_slot = weights.at[slot_asg].get(mode='fill', fill_value=0)
+    w1c, b1c = w1.astype(jnp.bfloat16), b1.astype(jnp.bfloat16)
+    w2c, b2c = w2.astype(jnp.bfloat16), b2.astype(jnp.bfloat16)
+
+    up_flops = 2 * EXPERTS * capacity * DIM * HIDDEN
+    t_fused_up = report(
+        'dispatch+up_mm[fused]',
+        time_fwd(lambda f: gather_rows_matmul(f, w1c, clamped, valid,
+                                              rows_per_group=capacity),
+                 flat),
+        flops=up_flops,
+        note='rows DMA from unpermuted tokens into the MXU tiles')
+
+    grown = nn.gelu(dispatch_phase(flat).reshape(EXPERTS, capacity, DIM)
+                    @ w1c + b1c[:, None]).reshape(EXPERTS * capacity, HIDDEN)
+
+    t_fused_down = report(
+        'down_mm+combine[fused]',
+        time_fwd(lambda g: matmul_scatter_rows(
+            g, w2c, b2c, slot_token, w_slot, TOKENS,
+            rows_per_group=capacity)[0], grown),
+        flops=up_flops,
+        note='k-way weighted combine in the matmul epilogue (RMW rows)')
+
+    # --- whole MoE layer, all three impls -------------------------------
     t_by_impl = {}
-    for impl in ('scatter', 'gather'):
+    for impl in ('scatter', 'gather', 'fused'):
         layer = MoEMLP(EXPERTS, k=K, mlp_ratio=RATIO, capacity_factor=CF,
                        dispatch='sparse', sparse_impl=impl)
         variables = layer.init(jax.random.PRNGKey(0), flat[:64])
@@ -259,6 +300,10 @@ def phases() -> None:
         'summary': {
             'phase_sum_us': round((t_router + t_seating + t_dispatch
                                    + t_ffn + t_combine) * 1e6, 1),
+            'layer_us_by_impl': {impl: round(t * 1e6, 1)
+                                 for impl, t in t_by_impl.items()},
+            'fused_up_us': round(t_fused_up * 1e6, 1),
+            'fused_down_us': round(t_fused_down * 1e6, 1),
             'moe_layer_us': round(t_layer * 1e6, 1),
             'dense_ffn_us': round(t_dense * 1e6, 1),
             'layer_vs_dense': round(t_layer / t_dense, 2),
@@ -269,15 +314,20 @@ def phases() -> None:
         }}))
 
 
-def whole_model() -> None:
-    """Re-measure the BASELINE whole-model MoE row (323M / 153M active)."""
+def whole_model(sparse_impl: str = 'gather') -> None:
+    """Re-measure the BASELINE whole-model MoE row (323M / 153M active).
+
+    ``python benchmarks/moe_ceiling.py whole [scatter|gather|fused]``
+    selects the single-shard row movement (BASELINE.md compares the
+    gather row against the fused grouped gather-matmul row)."""
     from tpusystem.models import GPT2
     from tpusystem.train import (AdamW, ChunkedNextTokenLoss, WithAuxLoss,
                                  build_train_step, flax_apply, init_state)
 
     batch, seq, steps = 16, 1024, 30
     module = GPT2(dropout=0.0, attention='flash', vocab_size=50304,
-                  return_features=True, moe_experts=EXPERTS, moe_every=2)
+                  return_features=True, moe_experts=EXPERTS, moe_every=2,
+                  moe_sparse_impl=sparse_impl)
     optimizer = AdamW(lr=3e-4, grad_clip=1.0)
     tokens = jnp.asarray(
         np.random.default_rng(0).integers(0, 50257, (batch, seq)), jnp.int32)
@@ -309,7 +359,8 @@ def whole_model() -> None:
     step_flops = 6 * active * batch * seq + attention_flops
     mfu = step_flops * steps / elapsed / peak_flops(jax.devices()[0])
     print(json.dumps({
-        'whole_model': {'params_m': round(params_count / 1e6, 1),
+        'whole_model': {'sparse_impl': sparse_impl,
+                        'params_m': round(params_count / 1e6, 1),
                         'active_m': round(active / 1e6, 1),
                         'steps_per_s': round(steps / elapsed, 2),
                         'tok_per_s': round(batch * seq * steps / elapsed),
@@ -318,6 +369,8 @@ def whole_model() -> None:
 
 if __name__ == '__main__':
     if 'whole' in sys.argv[1:]:
-        whole_model()
+        impls = [a for a in sys.argv[1:]
+                 if a in ('scatter', 'gather', 'fused')]
+        whole_model(*impls[:1])
     else:
         phases()

@@ -1,5 +1,6 @@
-"""Sparse vs dense MoE dispatch on one chip: tokens/s fwd+bwd, and the
-dense formulation's memory cliff (BASELINE.md round-2 numbers).
+"""Sparse vs dense MoE dispatch on one chip: tokens/s fwd+bwd, the
+dense formulation's memory cliff (BASELINE.md round-2 numbers), and the
+three-way scatter/gather/fused sparse-impl comparison (round 6).
 """
 import sys, time, json
 sys.path.insert(0, str(__import__("pathlib").Path(__file__).parent.parent))
@@ -7,8 +8,10 @@ import jax, jax.numpy as jnp, numpy as np
 from functools import partial
 from tpusystem.ops import MoEMLP
 
-def bench(dispatch, experts, tokens=8192, dim=768, steps=20):
-    module = MoEMLP(experts=experts, k=2, dtype=jnp.bfloat16, dispatch=dispatch)
+def bench(dispatch, experts, tokens=8192, dim=768, steps=20,
+          sparse_impl='gather'):
+    module = MoEMLP(experts=experts, k=2, dtype=jnp.bfloat16, dispatch=dispatch,
+                    sparse_impl=sparse_impl)
     hidden = jax.random.normal(jax.random.PRNGKey(0), (tokens // 512, 512, dim), jnp.bfloat16)
     params = module.init(jax.random.PRNGKey(1), hidden)['params']
 
@@ -37,7 +40,8 @@ def bench(dispatch, experts, tokens=8192, dim=768, steps=20):
     float(run(params, hidden))
     dt = time.perf_counter() - start
     tps = tokens * steps / dt
-    print(json.dumps({"dispatch": dispatch, "experts": experts,
+    tag = dispatch if dispatch != 'sparse' else f'sparse[{sparse_impl}]'
+    print(json.dumps({"dispatch": tag, "experts": experts,
                       "tokens_per_s": round(tps), "ms_per_step": round(dt/steps*1e3, 2)}))
     return tps
 
@@ -45,6 +49,16 @@ for experts in (8, 32, 64):
     d = bench('dense', experts)
     s = bench('sparse', experts)
     print(f'experts={experts}: sparse/dense speedup = {s/d:.2f}x')
+
+# three-way single-chip row movement: the scatter formulation, the
+# scatter-free gather custom_vjp pair, and the fused Pallas grouped
+# gather-matmul (dispatch in the up-matmul's loads, weighted combine in
+# the down-matmul's epilogue) — fwd+bwd tokens/s at the headline shapes
+print('--- sparse impls: scatter vs gather vs fused, 8 experts ---')
+impl_tps = {impl: bench('sparse', 8, sparse_impl=impl)
+            for impl in ('scatter', 'gather', 'fused')}
+print(f"fused/gather speedup = {impl_tps['fused']/impl_tps['gather']:.2f}x, "
+      f"gather/scatter = {impl_tps['gather']/impl_tps['scatter']:.2f}x")
 
 # the cliff: at 16k tokens x 64 experts the dense routing tensors are
 # ~1.3 GB each (+ gradients) -- RESOURCE_EXHAUSTED on a 16 GB chip, while

@@ -314,7 +314,10 @@ def test_ragged_seats_tokens_the_quota_path_drops():
 
 def test_sharded_sparse_guards():
     """Explicit dispatch='sparse' on a mesh it cannot serve raises with the
-    reason; 'auto' silently falls back to dense there."""
+    reason; 'auto' silently falls back to dense there. sparse_impl is
+    validated up front (a typo cannot ride the sharded path unnoticed)
+    and 'fused' raises on a multi-device mesh instead of silently running
+    a different implementation."""
     mesh = MeshSpec(data=2, expert=2, model=2).build()
     hidden = jnp.zeros((8, 16, 32), jnp.float32)
     module = MoEMLP(experts=4, dtype=jnp.float32, mesh=mesh,
@@ -323,6 +326,17 @@ def test_sharded_sparse_guards():
         module.init(jax.random.PRNGKey(0), hidden)
     auto = MoEMLP(experts=4, dtype=jnp.float32, mesh=mesh, dispatch='auto')
     auto.init(jax.random.PRNGKey(0), hidden)   # falls back, no raise
+
+    typo = MoEMLP(experts=4, dtype=jnp.float32, mesh=mesh,
+                  dispatch='auto', sparse_impl='fussed')
+    with pytest.raises(ValueError, match='unknown sparse_impl'):
+        typo.init(jax.random.PRNGKey(0), hidden)
+    fused_sharded = MoEMLP(experts=4, dtype=jnp.float32,
+                           mesh=MeshSpec(data=2, expert=2).build(
+                               jax.devices()[:4]),
+                           dispatch='sparse', sparse_impl='fused')
+    with pytest.raises(ValueError, match='single-shard only'):
+        fused_sharded.init(jax.random.PRNGKey(0), hidden)
 
 
 def test_gather_impl_matches_scatter_impl_exactly():
@@ -358,3 +372,159 @@ def test_gather_impl_matches_scatter_impl_exactly():
     for a, b in zip(jax.tree.leaves(grads_g), jax.tree.leaves(grads_s)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=1e-6, rtol=1e-6)
+
+
+def test_bf16_gradient_parity_across_sparse_impls():
+    """bfloat16-compute gradient parity — the dtype models actually train
+    in. Pins the f32 ``d_weights``/``d_buffer`` accumulation of
+    ``_gather_combine_bwd`` against the scatter formulation, and the
+    fused kernels' f32 MXU accumulation against both, with tolerances
+    sized to bf16 rounding (summation orders legitimately differ)."""
+    hidden = jax.random.normal(jax.random.PRNGKey(13), (4, 16, 32),
+                               jnp.float32)
+
+    def build(sparse_impl):
+        module = MoEMLP(experts=4, k=2, capacity_factor=1.25,
+                        dtype=jnp.bfloat16, dispatch='sparse',
+                        sparse_impl=sparse_impl)
+        params = module.init(jax.random.PRNGKey(0), hidden)['params']
+        return module, params
+
+    def loss(module):
+        def fn(p, hidden):
+            out, aux = module.apply({'params': p}, hidden)
+            return jnp.mean(out.astype(jnp.float32) ** 2) + aux
+        return fn
+
+    reference_module, params = build('scatter')
+    reference = jax.grad(loss(reference_module), argnums=(0, 1))(
+        params, hidden)
+    # gather's f32 d_weights/combine accumulation vs scatter's bf16
+    # scatter-add differ by summation order and rounding point, so even
+    # the gather comparison carries a (tight) tolerance in bf16; the
+    # fused kernels additionally accumulate matmuls in f32 on the MXU
+    # and get a looser bound
+    tolerance = {'gather': dict(rtol=0.05, atol=1e-4),
+                 'fused': dict(rtol=0.05, atol=2e-2)}
+    for impl in ('gather', 'fused'):
+        module, impl_params = build(impl)
+        for a, b in zip(jax.tree.leaves(params),
+                        jax.tree.leaves(impl_params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        grads = jax.grad(loss(module), argnums=(0, 1))(params, hidden)
+        for a, b in zip(jax.tree.leaves(reference), jax.tree.leaves(grads)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                err_msg=f'sparse_impl={impl}', **tolerance[impl])
+
+
+def test_fused_impl_matches_gather_impl():
+    """The fused grouped gather-matmul path (Pallas kernels under
+    ``interpret=True`` on CPU) reproduces the gather impl within float32
+    tolerance — forward, aux loss, and every gradient (params AND
+    hidden), including drop behavior at tight capacity, where the
+    sentinel row-skip paths of both kernels are exercised."""
+    hidden = jax.random.normal(jax.random.PRNGKey(17), (4, 16, 32),
+                               jnp.float32)
+
+    def build(sparse_impl, capacity_factor):
+        module = MoEMLP(experts=4, k=2, capacity_factor=capacity_factor,
+                        dtype=jnp.float32, dispatch='sparse',
+                        sparse_impl=sparse_impl)
+        params = module.init(jax.random.PRNGKey(0), hidden)['params']
+        return module, params
+
+    for capacity_factor in (0.75, 4.0):   # with drops / ample
+        gather_module, params = build('gather', capacity_factor)
+        fused_module, _ = build('fused', capacity_factor)
+
+        out_g, aux_g = gather_module.apply({'params': params}, hidden)
+        out_f, aux_f = fused_module.apply({'params': params}, hidden)
+        np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_g),
+                                   atol=1e-5)
+        np.testing.assert_allclose(float(aux_f), float(aux_g), rtol=1e-6)
+
+        def loss(module):
+            def fn(p, hidden):
+                out, aux = module.apply({'params': p}, hidden)
+                return jnp.mean(out ** 2) + aux
+            return fn
+
+        grads_g = jax.grad(loss(gather_module), argnums=(0, 1))(params,
+                                                                hidden)
+        grads_f = jax.grad(loss(fused_module), argnums=(0, 1))(params,
+                                                               hidden)
+        for a, b in zip(jax.tree.leaves(grads_g), jax.tree.leaves(grads_f)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-6, rtol=1e-4,
+                                       err_msg=f'cf={capacity_factor}')
+
+
+def test_grouped_matmul_kernels_match_einsum_reference():
+    """The Pallas kernels against plain einsum references, both operand
+    orientations (``transpose_rhs`` is what the backward's operand swap
+    uses), sentinel handling included."""
+    from tpusystem.ops.pallas.grouped_matmul import (gather_rows_matmul,
+                                                     matmul_scatter_rows)
+    rng = np.random.default_rng(3)
+    tokens, dim, hidden_dim, experts, capacity = 48, 16, 24, 4, 12
+    rows = experts * capacity
+    group_of = np.arange(rows) // capacity
+    src = rng.normal(size=(tokens, dim)).astype(np.float32)
+    w1 = rng.normal(size=(experts, dim, hidden_dim)).astype(np.float32)
+    ids = rng.integers(0, tokens + 1, rows).astype(np.int32)  # incl sentinel
+    clamped = np.minimum(ids, tokens - 1)
+    scale = (ids < tokens).astype(np.float32) * rng.random(rows).astype(
+        np.float32)
+
+    up = gather_rows_matmul(jnp.asarray(src), jnp.asarray(w1),
+                            jnp.asarray(clamped), jnp.asarray(scale),
+                            rows_per_group=capacity)
+    reference = np.einsum('rd,rdh->rh', src[clamped] * scale[:, None],
+                          w1[group_of])
+    np.testing.assert_allclose(np.asarray(up), reference, atol=1e-5)
+
+    up_t = gather_rows_matmul(jnp.asarray(src),
+                              jnp.asarray(w1.transpose(0, 2, 1)),
+                              jnp.asarray(clamped), jnp.asarray(scale),
+                              rows_per_group=capacity, transpose_rhs=True)
+    np.testing.assert_allclose(np.asarray(up_t), reference, atol=1e-5)
+
+    # scatter-combine: each expert seats a token at most once (the MoE
+    # seating invariant the RMW epilogue relies on)
+    lhs = rng.normal(size=(rows, hidden_dim)).astype(np.float32)
+    w2 = rng.normal(size=(experts, hidden_dim, dim)).astype(np.float32)
+    b2 = rng.normal(size=(experts, dim)).astype(np.float32)
+    toks = np.concatenate([rng.choice(tokens, capacity, replace=False)
+                           for _ in range(experts)]).astype(np.int32)
+    toks[::7] = tokens                              # sentinel slots
+    weights = rng.random(rows).astype(np.float32)
+    weights[toks >= tokens] = 0.0
+
+    out, buffer_rows = matmul_scatter_rows(
+        jnp.asarray(lhs), jnp.asarray(w2), jnp.asarray(b2),
+        jnp.asarray(toks), jnp.asarray(weights), tokens,
+        rows_per_group=capacity)
+    reference_rows = (np.einsum('rh,rhd->rd', lhs, w2[group_of])
+                      + b2[group_of])
+    reference_out = np.zeros((tokens, dim), np.float32)
+    for row in range(rows):
+        if toks[row] < tokens:
+            reference_out[toks[row]] += weights[row] * reference_rows[row]
+    np.testing.assert_allclose(np.asarray(buffer_rows), reference_rows,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out), reference_out, atol=1e-5)
+
+    # backward orientation: transposed rhs, no bias, rows not saved
+    out_t, no_rows = matmul_scatter_rows(
+        jnp.asarray(lhs), jnp.asarray(w2.transpose(0, 2, 1)), None,
+        jnp.asarray(toks), jnp.asarray(weights), tokens,
+        rows_per_group=capacity, transpose_rhs=True, save_rows=False)
+    reference_nb = np.zeros((tokens, dim), np.float32)
+    for row in range(rows):
+        if toks[row] < tokens:
+            reference_nb[toks[row]] += (weights[row]
+                                        * (reference_rows
+                                           - b2[group_of])[row])
+    assert no_rows is None
+    np.testing.assert_allclose(np.asarray(out_t), reference_nb, atol=1e-5)

@@ -108,6 +108,8 @@ class Block(nn.Module):
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_exchange: str = 'quota'
+    moe_sparse_impl: str = 'gather'  # single-shard row movement:
+    # 'gather' | 'scatter' | 'fused' (Pallas grouped gather-matmul)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -130,6 +132,7 @@ class Block(nn.Module):
                                  capacity_factor=self.moe_capacity_factor,
                                  dtype=self.dtype, mesh=self.mesh,
                                  exchange=self.moe_exchange,
+                                 sparse_impl=self.moe_sparse_impl,
                                  name='moe')(normed.astype(self.dtype))
         else:
             grown = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name='fc')(
@@ -176,6 +179,8 @@ class BlockSpan(nn.Module):
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_exchange: str = 'quota'
+    moe_sparse_impl: str = 'gather'  # single-shard row movement:
+    # 'gather' | 'scatter' | 'fused' (Pallas grouped gather-matmul)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -196,6 +201,7 @@ class BlockSpan(nn.Module):
                     moe_experts=self.moe_experts, moe_k=self.moe_k,
                     moe_capacity_factor=self.moe_capacity_factor,
                     moe_exchange=self.moe_exchange,
+                    moe_sparse_impl=self.moe_sparse_impl,
                     name=f'moe_{index}', **common)(hidden, train)
                 aux_terms.append(aux)
             else:
@@ -251,6 +257,8 @@ class GPT2(nn.Module):
     moe_capacity_factor: float = 1.25
     moe_exchange: str = 'quota'  # multi-device exchange: 'quota' | 'ragged'
     # | 'ragged-emulated' (see tpusystem.ops.moe.MoEMLP)
+    moe_sparse_impl: str = 'gather'  # single-shard row movement:
+    # 'gather' | 'scatter' | 'fused' (Pallas grouped gather-matmul)
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -316,6 +324,7 @@ class GPT2(nn.Module):
                                     moe_k=self.moe_k,
                                     moe_capacity_factor=self.moe_capacity_factor,
                                     moe_exchange=self.moe_exchange,
+                                    moe_sparse_impl=self.moe_sparse_impl,
                                     name='hs', **common)
                 length = self.layers // span_size
                 body = lambda block, carry, _: block(constrain(carry), train)
@@ -362,6 +371,7 @@ class GPT2(nn.Module):
                                   moe_k=self.moe_k,
                                   moe_capacity_factor=self.moe_capacity_factor,
                                   moe_exchange=self.moe_exchange,
+                                  moe_sparse_impl=self.moe_sparse_impl,
                                   name=f'h_{index}')
                 result = block(hidden, train)
                 if is_moe:

@@ -88,7 +88,14 @@ def attend(query, key, value, *, kernel: str = 'xla', mesh=None,
 
 def _debug_cache_enabled() -> bool:
     """Opt-in runtime verification of decode-cache contracts
-    (``TPUSYSTEM_DEBUG_CACHE=1``); read per trace so tests can flip it."""
+    (``TPUSYSTEM_DEBUG_CACHE=1``); read per trace so tests can flip it.
+
+    **Trace time, not run time**: the flag decides whether the check is
+    baked into the program, so already-compiled decode programs keep the
+    setting they were traced with. Set the env var before the first
+    ``generate`` call (or ``jax.clear_caches()`` to force a retrace) —
+    flipping it mid-process does not arm checks in cached executables.
+    """
     import os
     return os.environ.get('TPUSYSTEM_DEBUG_CACHE', '') == '1'
 
@@ -224,9 +231,11 @@ def cached_attention(module, query, key, value, max_seq: int,
     if len(buckets) == 1:
         return attend_over(max_seq)()
     filled = jnp.max(positions) + 1
-    index = sum((filled > width).astype(jnp.int32)
-                for width in buckets[:-1])
-    return jax.lax.switch(index, [attend_over(w) for w in buckets])
+    # NOT named `index`: that would shadow the flax cache variable of the
+    # same name assigned above and invite silent misuse of the cursor
+    bucket_index = sum((filled > width).astype(jnp.int32)
+                       for width in buckets[:-1])
+    return jax.lax.switch(bucket_index, [attend_over(w) for w in buckets])
 
 
 def dot_product_attention(query, key, value, *, causal: bool = True,

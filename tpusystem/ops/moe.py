@@ -9,6 +9,11 @@ weight tensor with a leading ``experts`` dimension sharded over the
   gives each assignment its position-in-expert, and scatter/gather moves
   only the O(tokens·k) selected rows (the dense tensors are
   O(tokens·experts·capacity) ≈ O(tokens²·k) in memory and FLOPs).
+  Single-shard row movement has three implementations behind
+  ``sparse_impl``: ``'scatter'`` (row scatter/scatter-add), ``'gather'``
+  (scatter-free custom_vjp pair) and ``'fused'`` (megablocks-style
+  Pallas grouped gather-matmul — the rows never make a standalone HBM
+  round trip at all; see :func:`_fused_moe`).
   Single-shard it runs directly; on multi-device meshes it runs inside
   ``shard_map`` with token rows sharded over (data, fsdp, seq, expert)
   and a regular differentiable ``all_to_all`` carrying each sender's
@@ -38,9 +43,9 @@ import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
-from tpusystem.parallel.mesh import EXPERT
+from tpusystem.parallel.mesh import EXPERT, axis_size, shard_map
 
 
 def _ragged_transport(transport: str, axis: str, operand, out_init,
@@ -61,7 +66,7 @@ def _ragged_transport(transport: str, axis: str, operand, out_init,
                                      out_off, recv_sz, axis_name=axis)
     if transport != 'gathered':
         raise ValueError(f'unknown ragged transport {transport!r}')
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     me = lax.axis_index(axis)
     all_ops = lax.all_gather(operand, axis)              # [n, S, cols]
     all_in_off = lax.all_gather(in_off, axis)            # [n, n]
@@ -306,26 +311,147 @@ def _gather_combine_fwd(buffer, weights, slots_by_choice, slot_token,
     return out, (buffer, weights, slots_by_choice, slot_token, slot_asg)
 
 
-def _gather_combine_bwd(residuals, d_out):
-    buffer, weights, slots_by_choice, slot_token, slot_asg = residuals
-    k = slots_by_choice.shape[0]
-    compute = buffer.dtype
+def _combine_bwd_terms(buffer, weights, slots_by_choice, slot_token,
+                       slot_asg, d_out, compute):
+    """The weighted-combine backward, shared by the gather impl and the
+    fused impl so their numerics cannot drift (tests pin them against
+    each other): ``d_buffer`` gathers the output cotangent by
+    ``slot_token`` scaled by the per-slot gate (compute dtype, empty
+    slots fill 0); ``d_weights`` is the choice-major concat of f32
+    rowwise dots of the re-gathered buffer rows with ``d_out``."""
     w_slot = weights.at[slot_asg].get(mode='fill', fill_value=0)
     d_buffer = (w_slot[:, None].astype(compute)
                 * d_out.at[slot_token].get(mode='fill', fill_value=0))
     d_w = []
-    for c in range(k):
+    for c in range(slots_by_choice.shape[0]):
         gathered = buffer.at[slots_by_choice[c]].get(mode='fill',
                                                      fill_value=0)
         d_w.append(jnp.sum(gathered.astype(jnp.float32)
                            * d_out.astype(jnp.float32), axis=-1))
-    d_weights = jnp.concatenate(d_w).astype(weights.dtype)
+    return d_buffer, jnp.concatenate(d_w).astype(weights.dtype)
+
+
+def _gather_combine_bwd(residuals, d_out):
+    buffer, weights, slots_by_choice, slot_token, slot_asg = residuals
+    d_buffer, d_weights = _combine_bwd_terms(
+        buffer, weights, slots_by_choice, slot_token, slot_asg, d_out,
+        buffer.dtype)
     zero = lambda a: np.zeros(a.shape, jax.dtypes.float0)
     return (d_buffer, d_weights, zero(slots_by_choice), zero(slot_token),
             zero(slot_asg))
 
 
 _gather_combine.defvjp(_gather_combine_fwd, _gather_combine_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _fused_moe(config, flat, w1, b1, w2, b2, weights, slot_token, slot_asg,
+               slots_by_choice):
+    """Megablocks-style fused sparse MoE: dispatch rides the first expert
+    matmul's loads, the k-way weighted combine rides the second's epilogue.
+
+    Two Pallas grouped-matmul kernels
+    (:mod:`tpusystem.ops.pallas.grouped_matmul`) replace the
+    dispatch/FFN/combine pipeline: :func:`gather_rows_matmul` DMAs token
+    rows from the *unpermuted* ``flat`` straight into the up-projection's
+    MXU tiles (the ``[experts*capacity, dim]`` dispatch buffer is never
+    materialized), and :func:`matmul_scatter_rows` accumulates each
+    down-projected row onto its token's output row, scaled by its combine
+    weight, in the matmul's epilogue (no buffer-order result is gathered
+    back). The backward reuses the SAME kernels with swapped operands —
+    ``d_buffer`` gather-matmuls the output cotangent against w2^T with the
+    combine weights as the per-row scale, ``d_flat`` matmul-scatters the
+    hidden cotangent against w1^T — with f32 MXU accumulation matching the
+    gather impl's numerics class (parity is tolerance-bounded, not
+    bitwise: summation orders differ).
+
+    ``config`` is ``(capacity, interpret)`` — static; ``interpret=None``
+    auto-selects interpreter mode off-TPU so CPU tests run the kernels.
+    Integer seating arrays ride as differentiable args returning float0
+    (the repo's custom_vjp convention). All float operands arrive in the
+    compute dtype; master-weight casts live in the caller.
+    """
+    out, _ = _fused_moe_fwd(config, flat, w1, b1, w2, b2, weights,
+                            slot_token, slot_asg, slots_by_choice)
+    return out
+
+
+def _fused_moe_fwd(config, flat, w1, b1, w2, b2, weights, slot_token,
+                   slot_asg, slots_by_choice):
+    from tpusystem.ops.pallas.grouped_matmul import (gather_rows_matmul,
+                                                     matmul_scatter_rows)
+    capacity, interpret = config
+    tokens = flat.shape[0]
+    experts = w1.shape[0]
+    clamped = jnp.minimum(slot_token, tokens - 1)
+    valid = (slot_token < tokens).astype(jnp.float32)
+    # per-slot combine weight; empty slots (sentinel slot_asg) fill 0
+    w_slot = weights.at[slot_asg].get(mode='fill', fill_value=0)
+
+    up = gather_rows_matmul(flat, w1, clamped, valid,
+                            rows_per_group=capacity, interpret=interpret)
+    pre = up.reshape(experts, capacity, -1) + b1[:, None]
+    grown = nn.gelu(pre).reshape(experts * capacity, -1)
+    out, shrunk = matmul_scatter_rows(grown, w2, b2, slot_token, w_slot,
+                                      tokens, rows_per_group=capacity,
+                                      interpret=interpret)
+    residuals = (flat, w1, b1, w2, b2, weights, slot_token, slot_asg,
+                 slots_by_choice, clamped, w_slot, pre, shrunk)
+    return out, residuals
+
+
+def _fused_moe_bwd(config, residuals, d_out):
+    from tpusystem.ops.pallas.grouped_matmul import (gather_rows_matmul,
+                                                     matmul_scatter_rows)
+    (flat, w1, b1, w2, b2, weights, slot_token, slot_asg, slots_by_choice,
+     clamped, w_slot, pre, shrunk) = residuals
+    capacity, interpret = config
+    tokens, compute = flat.shape[0], flat.dtype
+    experts = w1.shape[0]
+    valid = (slot_token < tokens).astype(jnp.float32)
+    grown = nn.gelu(pre)                           # recomputed, VPU-cheap
+
+    # combine backward: the EXACT terms of _gather_combine_bwd, via the
+    # shared helper, against the kernel-saved shrunk rows
+    d_shrunk, d_weights = _combine_bwd_terms(
+        shrunk, weights, slots_by_choice, slot_token, slot_asg, d_out,
+        compute)
+    d_shrunk3 = d_shrunk.reshape(experts, capacity, -1)
+    d_w2 = jnp.einsum('ech,ecd->ehd', grown, d_shrunk3,
+                      preferred_element_type=jnp.float32).astype(w2.dtype)
+    d_b2 = jnp.sum(d_shrunk3.astype(jnp.float32), axis=1).astype(b2.dtype)
+
+    # same kernel, swapped operands: d_grown[j] = w_slot[j] *
+    # d_out[token_j] @ w2[e]^T — the gather rides the matmul again
+    d_grown = gather_rows_matmul(d_out, w2, clamped, w_slot,
+                                 rows_per_group=capacity,
+                                 transpose_rhs=True, interpret=interpret)
+    _, gelu_vjp = jax.vjp(nn.gelu, pre)
+    (d_pre,) = gelu_vjp(d_grown.reshape(experts, capacity, -1)
+                        .astype(pre.dtype))
+    d_b1 = jnp.sum(d_pre.astype(jnp.float32), axis=1).astype(b1.dtype)
+
+    # dispatch backward: d_flat[t] = sum of t's seated d_expert_in rows,
+    # i.e. the scatter-combine kernel against w1^T with unit weights
+    d_flat, _ = matmul_scatter_rows(d_pre.reshape(experts * capacity, -1),
+                                    w1, None, slot_token, valid, tokens,
+                                    rows_per_group=capacity,
+                                    transpose_rhs=True, save_rows=False,
+                                    interpret=interpret)
+    # d_w1 needs the gathered rows the forward never materialized; one
+    # XLA gather rematerializes them (the gather impl's backward pays the
+    # same class of traffic)
+    expert_in = flat.at[slot_token].get(mode='fill', fill_value=0)
+    d_w1 = jnp.einsum('ecd,ech->edh',
+                      expert_in.reshape(experts, capacity, -1), d_pre,
+                      preferred_element_type=jnp.float32).astype(w1.dtype)
+
+    f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)
+    return (d_flat.astype(flat.dtype), d_w1, d_b1, d_w2, d_b2, d_weights,
+            f0(slot_token), f0(slot_asg), f0(slots_by_choice))
+
+
+_fused_moe.defvjp(_fused_moe_fwd, _fused_moe_bwd)
 
 
 class MoEMLP(nn.Module):
@@ -372,7 +498,12 @@ class MoEMLP(nn.Module):
     # through the scatter-free custom_vjp pair (_gather_dispatch /
     # _gather_combine — gathers + k-way sums in both directions, one tiny
     # int scatter to invert the seating); 'scatter' is the row-scatter
-    # formulation (the A/B reference; benchmarks/moe_ceiling.py)
+    # formulation (the A/B reference; benchmarks/moe_ceiling.py); 'fused'
+    # folds dispatch into the up-projection's loads and the weighted
+    # combine into the down-projection's epilogue with the Pallas grouped
+    # gather-matmul kernels (_fused_moe — megablocks-style; bitwise
+    # parity with gather/scatter is NOT expected, only tolerance-bounded:
+    # the MXU accumulates in f32 and sums in different orders)
     sparse_impl: str = 'gather'
 
     @nn.compact
@@ -399,6 +530,9 @@ class MoEMLP(nn.Module):
         # quotas). 'auto' falls back to the dense one-hot einsums when the
         # sharded preconditions don't hold (divisibility, unsharded model
         # axis); explicit 'sparse' raises instead of silently degrading.
+        if self.sparse_impl not in ('gather', 'scatter', 'fused'):
+            raise ValueError(f'unknown sparse_impl {self.sparse_impl!r}; '
+                             "expected 'gather', 'scatter' or 'fused'")
         mode = self.dispatch
         if mode == 'auto':
             if self.mesh is None or self.mesh.size == 1:
@@ -420,6 +554,19 @@ class MoEMLP(nn.Module):
         compute = jnp.dtype(self.dtype)
 
         if mode == 'sparse_sharded':
+            if self.sparse_impl == 'fused' and self.dispatch == 'sparse':
+                # the sharded formulations own their row movement (quota /
+                # ragged exchanges); the fused kernels are single-shard
+                # today. An EXPLICIT dispatch='sparse' raises rather than
+                # silently running a different impl (the repo contract);
+                # dispatch='auto' keeps its no-raise promise and proceeds
+                # with the sharded formulation.
+                raise ValueError(
+                    "sparse_impl='fused' is single-shard only; on a "
+                    'multi-device mesh the sharded sparse path uses its '
+                    "exchange formulation (see exchange=). Use "
+                    "sparse_impl='gather' there, or dispatch='auto' to "
+                    'accept the sharded formulation.')
             if self.exchange in ('ragged', 'ragged-emulated'):
                 output, aux = self._sharded_ragged(flat, router, w1, b1, w2,
                                                    b2, compute)
@@ -438,14 +585,34 @@ class MoEMLP(nn.Module):
                                    self.capacity_factor)
 
         if mode == 'sparse':
-            if self.sparse_impl not in ('gather', 'scatter'):
-                raise ValueError(f'unknown sparse_impl {self.sparse_impl!r}; '
-                                 "expected 'gather' or 'scatter'")
             token_ids, slots, weights, fraction = route_top_k_sparse(
                 gates, self.k, capacity)
+        else:
+            dispatch, combine, fraction = route_top_k(gates, self.k, capacity)
+
+        # Switch load-balance loss: experts * <fraction_dispatched * mean_prob>
+        balance = self.experts * jnp.sum(fraction * jnp.mean(gates, axis=0))
+        z_term = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        aux = self.balance_coef * balance + self.z_coef * z_term
+
+        if mode == 'sparse' and self.sparse_impl in ('gather', 'fused'):
+            # ONE seating inversion serves both impls — the parity their
+            # tests pin depends on them reading identical slot maps
+            slot_asg, slot_token, slots_by_choice = _invert_seating(
+                slots, self.k, tokens, self.experts * capacity)
+
+        if mode == 'sparse' and self.sparse_impl == 'fused':
+            # megablocks-style: both data movements ride the expert
+            # matmuls (Pallas grouped gather-matmul / matmul-scatter);
+            # no dispatch buffer, no combine gather — see _fused_moe
+            output = _fused_moe(
+                (capacity, None), flat.astype(compute), w1.astype(compute),
+                b1.astype(compute), w2.astype(compute), b2.astype(compute),
+                weights, slot_token, slot_asg, slots_by_choice)
+            return output.reshape(*batch_shape, dim).astype(hidden.dtype), aux
+
+        if mode == 'sparse':
             if self.sparse_impl == 'gather':
-                slot_asg, slot_token, slots_by_choice = _invert_seating(
-                    slots, self.k, tokens, self.experts * capacity)
                 expert_in = _gather_dispatch(flat.astype(compute),
                                              slot_token, slots_by_choice)
             else:
@@ -454,14 +621,8 @@ class MoEMLP(nn.Module):
                 expert_in = expert_in.at[slots].set(rows, mode='drop')
             expert_in = expert_in.reshape(self.experts, capacity, dim)
         else:
-            dispatch, combine, fraction = route_top_k(gates, self.k, capacity)
             expert_in = jnp.einsum('nec,nd->ecd', dispatch.astype(compute),
                                    flat.astype(compute))
-
-        # Switch load-balance loss: experts * <fraction_dispatched * mean_prob>
-        balance = self.experts * jnp.sum(fraction * jnp.mean(gates, axis=0))
-        z_term = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
-        aux = self.balance_coef * balance + self.z_coef * z_term
 
         expert_in = self._constrain(expert_in)
         shrunk = self._ffn(expert_in, w1, b1, w2, b2, compute)
@@ -495,10 +656,8 @@ class MoEMLP(nn.Module):
             gathered * weights[:, None].astype(compute))
 
     def _constrain(self, value):
-        if self.mesh is None or self.mesh.shape[EXPERT] == 1:
-            return value
-        sharding = NamedSharding(self.mesh, P(EXPERT, None, None))
-        return jax.lax.with_sharding_constraint(value, sharding)
+        from tpusystem.parallel.sharding import constrain_expert_major
+        return constrain_expert_major(value, self.mesh)
 
     def _sharded_sparse_blocker(self, tokens: int) -> str | None:
         """Why the sharded sparse path cannot run (None = it can)."""
@@ -567,7 +726,7 @@ class MoEMLP(nn.Module):
         row_spec = P(row_axes, None)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, check_vma=False,
+            shard_map, mesh=mesh, check_vma=False,
             in_specs=(row_spec, P(), P(EXPERT, None, None), P(EXPERT, None),
                       P(EXPERT, None, None), P(EXPERT, None)),
             out_specs=(row_spec, P()))
@@ -665,7 +824,7 @@ class MoEMLP(nn.Module):
         row_spec = P(row_axes, None)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, check_vma=False,
+            shard_map, mesh=mesh, check_vma=False,
             in_specs=(row_spec, P(), P(EXPERT, None, None), P(EXPERT, None),
                       P(EXPERT, None, None), P(EXPERT, None)),
             out_specs=(row_spec, P()))

@@ -1,0 +1,13 @@
+"""Hand-written Pallas TPU kernels (flash attention, grouped gather-matmul).
+
+Shared compat: jax renamed ``TPUCompilerParams`` -> ``CompilerParams``
+across releases; every kernel module takes the alias from here so the
+fallback logic lives once.
+"""
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, 'CompilerParams', None) \
+    or pltpu.TPUCompilerParams
+
+__all__ = ['CompilerParams']

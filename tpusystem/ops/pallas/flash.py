@@ -42,6 +42,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from tpusystem.ops.attention import NEG_INF
 
+from tpusystem.ops.pallas import CompilerParams
+
 LANES = 128  # VPU lane count: in-VMEM softmax stats are (block_q, LANES) tiles
 G1_VMEM_LIMIT = 96 * 1024 * 1024  # scoped-VMEM budget requested by the
              # resident-dq fused backward; past its estimated working set
@@ -561,7 +563,7 @@ def _flash_bwd_impl(causal, scale, block_q, block_kv, interpret, group,
                 pltpu.VMEM((block_kv, head_dim), jnp.float32),
                 pltpu.VMEM((block_kv, head_dim), jnp.float32),
             ],
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=CompilerParams(
                 vmem_limit_bytes=G1_VMEM_LIMIT),
             interpret=interpret,
         )(*seed_args, q, k, v, grad_out, lse, delta)
@@ -852,7 +854,7 @@ def sharded_flash_attention(query, key, value, mesh, *, causal: bool = True,
     from jax.sharding import PartitionSpec as P
 
     from tpusystem.ops.attention import repeat_kv_heads
-    from tpusystem.parallel.mesh import DATA, FSDP, MODEL
+    from tpusystem.parallel.mesh import DATA, FSDP, MODEL, shard_map
 
     shape = dict(mesh.shape)
     batch_axes = tuple(axis for axis in (DATA, FSDP) if shape.get(axis, 1) > 1)
@@ -874,7 +876,7 @@ def sharded_flash_attention(query, key, value, mesh, *, causal: bool = True,
 
     # check_vma=False: pallas_call out_shapes carry no varying-mesh-axis
     # info, so shard_map's replication checker cannot see through the kernel
-    @functools.partial(jax.shard_map, mesh=mesh, check_vma=False,
+    @functools.partial(shard_map, mesh=mesh, check_vma=False,
                        in_specs=(spec, spec, spec), out_specs=spec)
     def mapped(q, k, v):
         rng = dropout_rng

@@ -13,6 +13,8 @@ from __future__ import annotations
 import jax
 from jax import lax
 
+from tpusystem.parallel.mesh import axis_size as _axis_size
+
 
 def all_reduce_sum(value, axis: str):
     """Sum over every shard on ``axis`` (gradient reduction)."""
@@ -44,7 +46,7 @@ def ring_shift(value, axis: str, *, reverse: bool = False):
     the ``ppermute`` at the heart of ring attention and 1F1B pipelines.
     Neighbor convention: rank ``i`` sends to ``(i+1) % n`` when forward.
     """
-    size = lax.axis_size(axis)
+    size = _axis_size(axis)
     if reverse:
         permutation = [(source, (source - 1) % size) for source in range(size)]
     else:
@@ -57,4 +59,4 @@ def axis_index(axis: str):
 
 
 def axis_size(axis: str):
-    return lax.axis_size(axis)
+    return _axis_size(axis)

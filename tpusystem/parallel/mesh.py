@@ -37,6 +37,47 @@ DATA, FSDP, MODEL, SEQ, EXPERT, STAGE = 'data', 'fsdp', 'model', 'seq', 'expert'
 AXES = (DATA, FSDP, MODEL, SEQ, EXPERT, STAGE)
 
 
+def axis_size(axis) -> int:
+    """Static size of a mapped mesh axis, inside ``shard_map``.
+
+    ``jax.lax.axis_size`` where this install has it; the classic
+    ``psum(1, axis)`` idiom (constant-folded to a Python int) where it
+    predates it. The compat twin of :func:`shard_map` below.
+    """
+    if hasattr(jax.lax, 'axis_size'):
+        return jax.lax.axis_size(axis)
+    return jax.lax.psum(1, axis)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
+              axis_names=None):
+    """``jax.shard_map`` with a fallback for jax installs that predate it.
+
+    Every manual-collective path in the repo (MoE expert dispatch, ring
+    attention, sharded flash, the pipeline schedule) routes through this
+    one seam instead of ``jax.shard_map`` directly. On current jax it is
+    a passthrough; on older installs (``jax.shard_map`` landed after
+    0.4.x) it adapts ``jax.experimental.shard_map.shard_map``:
+    ``check_vma`` maps to the old ``check_rep``, and ``axis_names`` (the
+    axes handled *manually*; all, when omitted) maps to its complement,
+    the old ``auto`` set. Caveat on the legacy path: partially-manual
+    mappings (``axis_names`` smaller than the mesh — PP x TP) lower only
+    where that jaxlib supports the PartitionId instruction under SPMD,
+    which excludes the CPU test backend.
+    """
+    if hasattr(jax, 'shard_map'):
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=check_vma)
+        if axis_names is not None:
+            kwargs['axis_names'] = axis_names
+        return jax.shard_map(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+    auto = (frozenset(mesh.axis_names) - frozenset(axis_names)
+            if axis_names is not None else frozenset())
+    return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=check_vma, auto=auto)
+
+
 def force_host_platform(n_devices: int = 8) -> None:
     """Force JAX onto the host (CPU) platform with ``n_devices`` virtual chips.
 

@@ -38,7 +38,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from tpusystem.parallel.mesh import DATA, FSDP, MODEL, STAGE
+from tpusystem.parallel.mesh import (DATA, FSDP, MODEL, STAGE, axis_size,
+                                     shard_map)
 from tpusystem.parallel.sharding import ShardingPolicy
 
 # One layer of the pipelined stack: (layer_params, activations) -> activations
@@ -82,9 +83,12 @@ def _needs_jit_wrap(mesh) -> bool:
     so PP x TP calls are wrapped unconditionally — a non-jit trace
     context (eager ``jax.grad``, ``vmap``, ``eval_shape``) needs the
     wrapper just as plain eager execution does, and under an outer jit
-    the nested jit is cheap. Note an *eager* caller pays a fresh trace
-    per call (the closure is rebuilt each time): jit the surrounding
-    step for anything hot."""
+    the nested jit is cheap. The wrapper (and the traced schedule inside
+    it) is memoized per stacked-params structure in
+    :func:`pipeline_train`, so eager PP x TP callers compile once and
+    replay from jit's cache; without a model axis the runner is a bare
+    ``shard_map`` and eager callers still pay per-call tracing — jit the
+    surrounding step for anything hot."""
     return mesh.shape.get(MODEL, 1) > 1
 
 
@@ -164,13 +168,13 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
     run_unit = _unit_runner(mesh)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        shard_map, mesh=mesh,
         in_specs=(param_specs, activation_spec),
         out_specs=activation_spec, check_vma=False,
         axis_names=_manual_axes(mesh))
     def pipelined(params, local_hidden):
         stage = lax.axis_index(STAGE)
-        count = lax.axis_size(STAGE)
+        count = axis_size(STAGE)
         shape = (microbatches, local_hidden.shape[0] // microbatches)
         batches = local_hidden.reshape(shape + local_hidden.shape[1:])
         if chunks == 1:
@@ -408,18 +412,21 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
     stage_body = _stage_scan(block_fn)
     run_unit = _unit_runner(mesh)
 
-    def step(replicated_params, stacked_params, inputs, targets):
-        if inputs.shape[0] % (data_parallel * microbatches):
-            raise ValueError(
-                f'batch {inputs.shape[0]} not divisible by '
-                f'data*fsdp*microbatches = {data_parallel}*{microbatches}')
+    batch_spec = P(batch_axes)
+    chunk_spec = P(STAGE) if chunks == 1 else P(None, STAGE)
+    # the traced pipeline is memoized per stacked-params STRUCTURE (the
+    # only input the shard_map specs depend on): an eager PP x TP caller
+    # used to rebuild `run` and re-wrap it in a fresh `jax.jit` every
+    # step, retracing the whole schedule each call — now the wrapper is
+    # built once and jit's own cache handles shape changes
+    runners: dict = {}
 
-        batch_spec = P(batch_axes)
-        chunk_spec = P(STAGE) if chunks == 1 else P(None, STAGE)
-        param_specs = jax.tree.map(lambda _: chunk_spec, stacked_params)
+    def _build_runner(param_structure):
+        param_specs = param_structure.unflatten(
+            [chunk_spec] * param_structure.num_leaves)
 
         @functools.partial(
-            jax.shard_map, mesh=mesh, check_vma=False,
+            shard_map, mesh=mesh, check_vma=False,
             in_specs=(P(), param_specs, batch_spec, batch_spec),
             out_specs=(P(), (P(), param_specs)),
             axis_names=_manual_axes(mesh))
@@ -641,7 +648,17 @@ def pipeline_train(head_fn, block_fn, tail_fn, mesh, *, microbatches: int,
                 carry['d_stacked'], stacked_in)
             return loss, (d_reps, d_stacked)
 
-        runner = jax.jit(run) if _needs_jit_wrap(mesh) else run
+        return jax.jit(run) if _needs_jit_wrap(mesh) else run
+
+    def step(replicated_params, stacked_params, inputs, targets):
+        if inputs.shape[0] % (data_parallel * microbatches):
+            raise ValueError(
+                f'batch {inputs.shape[0]} not divisible by '
+                f'data*fsdp*microbatches = {data_parallel}*{microbatches}')
+        structure = jax.tree.structure(stacked_params)
+        runner = runners.get(structure)
+        if runner is None:
+            runner = runners[structure] = _build_runner(structure)
         return runner(replicated_params, stacked_params, inputs, targets)
 
     return step

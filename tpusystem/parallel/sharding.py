@@ -27,10 +27,40 @@ from typing import Any, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from tpusystem.parallel.mesh import FSDP
+from tpusystem.parallel.mesh import EXPERT, FSDP
 from tpusystem.registry import register
 
 Rules = Sequence[tuple[str, PartitionSpec]]
+
+
+def expert_major_spec(rank: int) -> PartitionSpec:
+    """Spec for expert-major activation buffers: the leading dim carries
+    the expert id — either explicitly (``[experts, capacity, dim]``) or
+    flattened into the row index (``[experts * capacity, dim]``, the
+    layout the fused grouped-matmul kernels and the gather/scatter
+    dispatch buffers share) — and shards over the ``expert`` mesh axis;
+    every other dim stays unsharded."""
+    return PartitionSpec(EXPERT, *([None] * (rank - 1)))
+
+
+def constrain_expert_major(value, mesh):
+    """Pin an expert-major buffer to the ``expert`` axis (no-op off-mesh).
+
+    The single annotation point for MoE dispatch intermediates — the
+    dense one-hot einsum operands and the sparse
+    ``[experts, capacity, dim]`` buffers — so GSPMD places the expert
+    FFN's inputs/outputs on the experts' owners instead of choosing.
+    Design note for the fused grouped-matmul path (single-shard today,
+    and ``MoEMLP`` raises rather than silently substituting it on a
+    multi-device mesh): ``pallas_call`` is a manual computation GSPMD
+    cannot split, so a sharded-fused path would run the kernels one
+    device per shard inside ``shard_map`` (like the flash kernels), with
+    this constraint keeping the surrounding auto-partitioned tensors
+    aligned to that boundary."""
+    if mesh is None or mesh.shape.get(EXPERT, 1) == 1:
+        return value
+    sharding = NamedSharding(mesh, expert_major_spec(value.ndim))
+    return jax.lax.with_sharding_constraint(value, sharding)
 
 
 def leaf_path(key_path) -> str:
