@@ -97,6 +97,56 @@ def test_loader_shapes_and_determinism():
                                   np.asarray(list(other)[0][1]))
 
 
+def test_loader_prefetch_thread_shuts_down_on_early_close():
+    """Abandoning the iterator mid-epoch must stop the background
+    prefetch thread (no producer left blocked on a full queue)."""
+    import threading
+    dataset = SyntheticDigits(samples=256, seed=1)
+    loader = Loader(dataset, batch_size=8, shuffle=False, prefetch=2)
+    iterator = iter(loader)
+    next(iterator)
+    iterator.close()    # GeneratorExit -> stop flag -> thread joins
+    for _ in range(100):
+        if not any(t.name == 'loader-prefetch' and t.is_alive()
+                   for t in threading.enumerate()):
+            break
+        import time
+        time.sleep(0.02)
+    assert not any(t.name == 'loader-prefetch' and t.is_alive()
+                   for t in threading.enumerate())
+
+
+def test_loader_prefetch_matches_direct_indexing():
+    """The background-thread pipeline yields exactly the batches direct
+    fancy indexing produces, in order."""
+    dataset = SyntheticDigits(samples=96, seed=2)
+    loader = Loader(dataset, batch_size=16, shuffle=True, seed=11)
+    order = loader._order()
+    batches = list(loader)
+    assert len(batches) == 6
+    for index, (inputs, targets) in enumerate(batches):
+        span = order[index * 16:(index + 1) * 16]
+        expected_inputs, expected_targets = dataset[span]
+        np.testing.assert_array_equal(np.asarray(inputs),
+                                      np.asarray(expected_inputs))
+        np.testing.assert_array_equal(np.asarray(targets),
+                                      np.asarray(expected_targets))
+
+
+def test_loader_prefetch_propagates_worker_errors():
+    """An exception in the prefetch thread re-raises on the consumer."""
+    class Exploding:
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, index):
+            raise RuntimeError('bad shard')
+
+    loader = Loader(Exploding(), batch_size=16)
+    with pytest.raises(RuntimeError, match='bad shard'):
+        list(loader)
+
+
 def test_loader_identity_excludes_dataset():
     dataset = SyntheticDigits(samples=64)
     loader = Loader(dataset, batch_size=16, shuffle=True, seed=5)

@@ -3,10 +3,12 @@
 The reference moves every batch host->device inside the hot loop
 (``examples/tinysys/tinysys/services/training.py:33`` — ``.to(device)`` per
 batch). On TPU that transfer must overlap compute: the :class:`Loader`
-double-buffers ``jax.device_put`` (which is asynchronous) so batch *N+1* is
-in flight over PCIe/ICI while batch *N* computes, and places each batch with
-an optional ``NamedSharding`` so a global batch lands pre-sharded across the
-mesh data axis.
+prepares batches on a background prefetch thread — the ``dataset[span]``
+gather AND the (asynchronous) ``jax.device_put`` both run off the training
+thread, keeping up to ``prefetch`` batches in flight — so batch *N+1*'s
+host prep and PCIe/ICI transfer overlap batch *N*'s device compute, and
+places each batch with an optional ``NamedSharding`` so a global batch
+lands pre-sharded across the mesh data axis.
 
 ``Loader`` is registry-friendly: its hyperparameters (batch size, shuffle
 seed) capture into the identity hash of the experiment, with the dataset
@@ -16,6 +18,8 @@ in the reference composition root (``examples/tinysys/main.py:31``).
 
 from __future__ import annotations
 
+import queue
+import threading
 from collections.abc import Iterator, Sequence
 from typing import Any
 
@@ -46,6 +50,14 @@ class ArrayDataset:
             from tpusystem.data import native
             return tuple(native.gather(array, index) for array in self.arrays)
         return tuple(array[index] for array in self.arrays)
+
+
+class _PrefetchError:
+    """Carries a prefetch-thread exception across the queue so it
+    re-raises on the consuming thread."""
+
+    def __init__(self, error: BaseException):
+        self.error = error
 
 
 class Loader:
@@ -93,18 +105,70 @@ class Loader:
         return tuple(jax.device_put(part) for part in batch)
 
     def __iter__(self) -> Iterator[tuple]:
+        """Yield device-placed batches, prepared by a background thread.
+
+        Host-side batch prep — the ``dataset[span]`` gather plus the
+        (asynchronous) ``device_put`` — runs in a prefetch thread, so
+        step ``N+1``'s indexing/copy overlaps step ``N``'s device
+        compute instead of serializing into the training loop. The
+        thread keeps at most ``prefetch`` batches queued ahead of
+        consumption (the depth semantics of the old double-buffer), and
+        shuts down cleanly when the generator is closed early: every
+        queue operation polls a stop flag, so an abandoned iterator
+        never leaves a blocked producer behind.
+        """
         order = self._order()
         self._epoch += 1
         spans = [order[start:start + self.batch_size]
                  for start in range(0, len(order), self.batch_size)]
         if self.drop_remainder and spans and len(spans[-1]) < self.batch_size:
             spans.pop()
-        buffered: list[tuple] = []
-        for span in spans:
-            buffered.append(self._place(self.dataset[span]))
-            if len(buffered) > self.prefetch:
-                yield buffered.pop(0)
-        yield from buffered
+        if not spans:
+            return
+        buffer: queue.Queue = queue.Queue(maxsize=max(self.prefetch, 1))
+        stop = threading.Event()
+        done = object()          # sentinel: producer finished cleanly
+
+        def offer(item) -> bool:
+            while not stop.is_set():
+                try:
+                    buffer.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce() -> None:
+            try:
+                for span in spans:
+                    if stop.is_set():
+                        return
+                    if not offer(self._place(self.dataset[span])):
+                        return
+                offer(done)
+            except BaseException as error:    # re-raised on the consumer
+                offer(_PrefetchError(error))
+
+        thread = threading.Thread(target=produce, daemon=True,
+                                  name='loader-prefetch')
+        thread.start()
+        try:
+            while True:
+                item = buffer.get()
+                if item is done:
+                    break
+                if isinstance(item, _PrefetchError):
+                    raise item.error
+                yield item
+        finally:
+            stop.set()
+            # drain so a producer blocked on a full queue sees the flag
+            while thread.is_alive():
+                try:
+                    buffer.get_nowait()
+                except queue.Empty:
+                    pass
+                thread.join(timeout=0.05)
 
 
 register(Loader, excluded_args=[0], excluded_kwargs={'dataset'})

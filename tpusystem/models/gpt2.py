@@ -110,9 +110,16 @@ class Block(nn.Module):
     moe_exchange: str = 'quota'
     moe_sparse_impl: str = 'gather'  # single-shard row movement:
     # 'gather' | 'scatter' | 'fused' (Pallas grouped gather-matmul)
+    tp_impl: str = 'gspmd'  # dense-FFN TP collectives: 'gspmd' (monolithic
+    # all-gather/reduce-scatter inserted by the partitioner) | 'overlap'
+    # (decomposed latency-hiding ring matmuls, parallel/overlap.py)
+    tp_chunks: int = 1  # ppermute payload split per overlap ring hop
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
+        if self.tp_impl not in ('gspmd', 'overlap'):
+            raise ValueError(f'unknown tp_impl {self.tp_impl!r}; '
+                             "expected 'gspmd' or 'overlap'")
         dim = hidden.shape[-1]
         normed = nn.LayerNorm(dtype=jnp.float32, name='ln_1')(hidden)
         attended = SelfAttention(self.heads, self.dropout, self.dtype,
@@ -135,10 +142,32 @@ class Block(nn.Module):
                                  sparse_impl=self.moe_sparse_impl,
                                  name='moe')(normed.astype(self.dtype))
         else:
-            grown = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype, name='fc')(
-                normed.astype(self.dtype))
-            grown = nn.gelu(grown)
-            shrunk = nn.Dense(dim, dtype=self.dtype, name='proj')(grown)
+            from tpusystem.parallel.overlap import (DenseParams,
+                                                    overlap_applicable,
+                                                    tp_ffn)
+            grown_features = self.mlp_ratio * dim
+            if (self.tp_impl == 'overlap'
+                    and overlap_applicable(self.mesh, normed.shape,
+                                           grown_features)):
+                # decomposed TP collectives: the sequence rows all-gather
+                # INTO the fc matmul and the proj matmul reduce-scatters
+                # them back, each ring hop hidden under the partial
+                # matmuls (parallel/overlap.py). Params are created at
+                # nn.Dense's exact paths, so the knob never changes a
+                # checkpoint; shapes that cannot tile fall through to the
+                # GSPMD Dense path below.
+                w_fc, b_fc = DenseParams(grown_features, name='fc')(dim)
+                w_proj, b_proj = DenseParams(dim, name='proj')(grown_features)
+                shrunk = tp_ffn(
+                    normed.astype(self.dtype),
+                    w_fc.astype(self.dtype), b_fc.astype(self.dtype),
+                    w_proj.astype(self.dtype), b_proj.astype(self.dtype),
+                    self.mesh, activation=nn.gelu, chunks=self.tp_chunks)
+            else:
+                grown = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype,
+                                 name='fc')(normed.astype(self.dtype))
+                grown = nn.gelu(grown)
+                shrunk = nn.Dense(dim, dtype=self.dtype, name='proj')(grown)
             aux = None
         shrunk = nn.Dropout(self.dropout, deterministic=not train)(shrunk)
         hidden = hidden + shrunk
@@ -181,13 +210,16 @@ class BlockSpan(nn.Module):
     moe_exchange: str = 'quota'
     moe_sparse_impl: str = 'gather'  # single-shard row movement:
     # 'gather' | 'scatter' | 'fused' (Pallas grouped gather-matmul)
+    tp_impl: str = 'gspmd'  # dense-FFN TP collectives: 'gspmd' | 'overlap'
+    tp_chunks: int = 1
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
         common = dict(attention=self.attention, mesh=self.mesh,
                       attn_dropout=self.attn_dropout, decode=self.decode,
                       max_seq=self.max_seq,
-                      per_row_decode=self.per_row_decode)
+                      per_row_decode=self.per_row_decode,
+                      tp_impl=self.tp_impl, tp_chunks=self.tp_chunks)
         if self.moe_experts and self.span % self.moe_every:
             raise ValueError(f'span ({self.span}) must be a multiple of '
                              f'moe_every ({self.moe_every})')
@@ -259,6 +291,11 @@ class GPT2(nn.Module):
     # | 'ragged-emulated' (see tpusystem.ops.moe.MoEMLP)
     moe_sparse_impl: str = 'gather'  # single-shard row movement:
     # 'gather' | 'scatter' | 'fused' (Pallas grouped gather-matmul)
+    tp_impl: str = 'gspmd'  # dense-FFN TP collectives: 'gspmd' (monolithic
+    # partitioner-inserted all-gather/reduce-scatter) | 'overlap'
+    # (decomposed latency-hiding ring matmuls — parallel/overlap.py;
+    # needs a mesh with model > 1, falls back per-shape otherwise)
+    tp_chunks: int = 1  # ppermute payload split per overlap ring hop
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -295,7 +332,8 @@ class GPT2(nn.Module):
             common = dict(attention=self.attention, mesh=self.mesh,
                           attn_dropout=self.attn_dropout,
                           decode=self.decode, max_seq=self.max_seq,
-                          per_row_decode=self.per_row_decode)
+                          per_row_decode=self.per_row_decode,
+                          tp_impl=self.tp_impl, tp_chunks=self.tp_chunks)
             from tpusystem.parallel.mesh import scan_carry_constraint
             constrain = scan_carry_constraint(self.mesh)
             if self.moe_experts:
@@ -372,6 +410,8 @@ class GPT2(nn.Module):
                                   moe_capacity_factor=self.moe_capacity_factor,
                                   moe_exchange=self.moe_exchange,
                                   moe_sparse_impl=self.moe_sparse_impl,
+                                  tp_impl=self.tp_impl,
+                                  tp_chunks=self.tp_chunks,
                                   name=f'h_{index}')
                 result = block(hidden, train)
                 if is_moe:

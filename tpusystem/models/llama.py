@@ -157,9 +157,14 @@ class LlamaBlock(nn.Module):
     decode: bool = False
     max_seq: int = 8192
     per_row_decode: bool = False
+    tp_impl: str = 'gspmd'  # SwiGLU TP collectives: 'gspmd' | 'overlap'
+    tp_chunks: int = 1
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
+        if self.tp_impl not in ('gspmd', 'overlap'):
+            raise ValueError(f'unknown tp_impl {self.tp_impl!r}; '
+                             "expected 'gspmd' or 'overlap'")
         dim = hidden.shape[-1]
         normed = RMSNorm(name='attn_norm')(hidden)
         hidden = hidden + LlamaAttention(
@@ -168,6 +173,27 @@ class LlamaBlock(nn.Module):
             max_seq=self.max_seq, per_row_decode=self.per_row_decode,
             name='attn')(normed, train)
         normed = RMSNorm(name='ffn_norm')(hidden)
+        from tpusystem.parallel.overlap import (DenseParams,
+                                                overlap_applicable,
+                                                tp_swiglu)
+        if (self.tp_impl == 'overlap'
+                and overlap_applicable(self.mesh, normed.shape,
+                                       self.ffn_dim)):
+            # decomposed TP collectives (parallel/overlap.py): one ring
+            # all-gathers the sequence rows into the fused gate|up matmul,
+            # the down matmul reduce-scatters them back, transfers hidden
+            # under the partial matmuls. Same param paths as nn.Dense, so
+            # the knob never changes a checkpoint; non-tiling shapes fall
+            # through to the GSPMD path below.
+            w_gate, _ = DenseParams(self.ffn_dim, use_bias=False,
+                                    name='gate')(dim)
+            w_up, _ = DenseParams(self.ffn_dim, use_bias=False,
+                                  name='up')(dim)
+            w_down, _ = DenseParams(dim, use_bias=False,
+                                    name='down')(self.ffn_dim)
+            return hidden + tp_swiglu(
+                normed, w_gate.astype(self.dtype), w_up.astype(self.dtype),
+                w_down.astype(self.dtype), self.mesh, chunks=self.tp_chunks)
         dense = lambda features, name: nn.Dense(
             features, use_bias=False, dtype=self.dtype, name=name)
         gated = nn.silu(dense(self.ffn_dim, 'gate')(normed)) \
@@ -194,6 +220,8 @@ class LlamaBlockSpan(nn.Module):
     decode: bool = False
     max_seq: int = 8192
     per_row_decode: bool = False
+    tp_impl: str = 'gspmd'
+    tp_chunks: int = 1
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -203,6 +231,8 @@ class LlamaBlockSpan(nn.Module):
                                 attention=self.attention, mesh=self.mesh,
                                 decode=self.decode, max_seq=self.max_seq,
                                 per_row_decode=self.per_row_decode,
+                                tp_impl=self.tp_impl,
+                                tp_chunks=self.tp_chunks,
                                 name=f'd_{index}')(hidden, train)
         return hidden
 
@@ -243,6 +273,11 @@ class Llama(nn.Module):
     per_row_decode: bool = False  # per-row cache cursors for speculative
     # decoding (scatter writes); False = ordinary decode, shared-cursor
     # dynamic_update_slice cache writes
+    tp_impl: str = 'gspmd'  # SwiGLU TP collectives: 'gspmd' (monolithic
+    # partitioner-inserted all-gather/reduce-scatter) | 'overlap'
+    # (decomposed latency-hiding ring matmuls — parallel/overlap.py;
+    # needs a mesh with model > 1, falls back per-shape otherwise)
+    tp_chunks: int = 1  # ppermute payload split per overlap ring hop
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -273,6 +308,8 @@ class Llama(nn.Module):
                                     mesh=self.mesh, decode=self.decode,
                                     max_seq=self.max_seq,
                                     per_row_decode=self.per_row_decode,
+                                    tp_impl=self.tp_impl,
+                                    tp_chunks=self.tp_chunks,
                                     name='blocks')
                 length = self.layers // self.scan_unit
             else:
@@ -283,6 +320,8 @@ class Llama(nn.Module):
                                      mesh=self.mesh, decode=self.decode,
                                      max_seq=self.max_seq,
                                      per_row_decode=self.per_row_decode,
+                                     tp_impl=self.tp_impl,
+                                     tp_chunks=self.tp_chunks,
                                      name='blocks')
                 length = self.layers
             from tpusystem.parallel.mesh import scan_carry_constraint
@@ -301,6 +340,8 @@ class Llama(nn.Module):
                                    attention=self.attention, mesh=self.mesh,
                                    decode=self.decode, max_seq=self.max_seq,
                                    per_row_decode=self.per_row_decode,
+                                   tp_impl=self.tp_impl,
+                                   tp_chunks=self.tp_chunks,
                                    name=f'layer_{index}')(hidden, train)
         hidden = RMSNorm(name='final_norm')(hidden)
         # untied head (Llama-3 convention). bf16 x bf16 operands at MXU

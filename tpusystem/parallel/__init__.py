@@ -11,7 +11,11 @@ from tpusystem.parallel.multihost import (
 )
 from tpusystem.parallel.collectives import (
     all_gather, all_reduce_mean, all_reduce_sum, all_to_all, axis_index,
-    axis_size, reduce_scatter, ring_shift,
+    axis_size, reduce_scatter, ring_shift, ring_shift_chunked,
+)
+from tpusystem.parallel.overlap import (
+    allgather_matmul, allgather_plan, matmul_reducescatter,
+    reducescatter_plan, tp_ffn, tp_swiglu,
 )
 from tpusystem.parallel.pipeline import (PipelineParallel,
                                          compose_stacked_rules,
@@ -35,5 +39,7 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'WorkerLost', 'WorkerJoined',
            'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT',
            'all_reduce_sum', 'all_reduce_mean', 'all_gather',
-           'reduce_scatter', 'all_to_all', 'ring_shift', 'axis_index',
-           'axis_size']
+           'reduce_scatter', 'all_to_all', 'ring_shift',
+           'ring_shift_chunked', 'axis_index', 'axis_size',
+           'allgather_matmul', 'matmul_reducescatter',
+           'allgather_plan', 'reducescatter_plan', 'tp_ffn', 'tp_swiglu']

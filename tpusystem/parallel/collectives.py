@@ -11,6 +11,7 @@ vocabulary with ring-neighbor conventions fixed in a single place.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from tpusystem.parallel.mesh import axis_size as _axis_size
@@ -52,6 +53,33 @@ def ring_shift(value, axis: str, *, reverse: bool = False):
     else:
         permutation = [(source, (source + 1) % size) for source in range(size)]
     return lax.ppermute(value, axis, permutation)
+
+
+def ring_shift_chunked(value, axis: str, *, chunks: int = 1,
+                       reverse: bool = False):
+    """:func:`ring_shift` with the payload split into ``chunks``
+    independent ``ppermute``\\ s along dimension 0.
+
+    Semantically identical to one monolithic shift; the split gives XLA's
+    latency-hiding scheduler ``chunks`` independent transfers it can
+    interleave with compute at finer granularity — the knob the
+    decomposed TP matmuls (:mod:`tpusystem.parallel.overlap`) sweep.
+    Shares :func:`ring_shift`'s neighbor convention exactly — rank ``i``
+    sends to ``(i + 1) % n`` when forward — so after ``s`` forward shifts
+    a device holds the shard of rank ``(i - s) % n``; the all-gather and
+    reduce-scatter decompositions both index their row-blocks from that
+    convention, which is what keeps the two duals' transposes reusable as
+    each other's backward. Requires ``value.shape[0] % chunks == 0``
+    (callers plan around this; see ``overlap.allgather_plan``).
+    """
+    if chunks <= 1:
+        return ring_shift(value, axis, reverse=reverse)
+    if value.shape[0] % chunks:
+        raise ValueError(f'cannot split {value.shape[0]} rows into '
+                         f'{chunks} ppermute chunks')
+    pieces = jnp.split(value, chunks, axis=0)
+    shifted = [ring_shift(piece, axis, reverse=reverse) for piece in pieces]
+    return jnp.concatenate(shifted, axis=0)
 
 
 def axis_index(axis: str):

@@ -24,6 +24,7 @@ experiment identity hash, so checkpoints distinguish incompatible layouts
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Sequence
 
@@ -76,6 +77,87 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True,
             if axis_names is not None else frozenset())
     return legacy(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                   check_rep=check_vma, auto=auto)
+
+
+# The miniature of the pipeline's PP x TP composition: a *partially
+# manual* shard_map (stage manual, model auto) whose body ppermutes an
+# activation that GSPMD partitions over the auto axis. jaxlibs that
+# cannot lower the PartitionId instruction under SPMD on CPU fail here —
+# some with a catchable UNIMPLEMENTED, some with a fatal
+# spmd_partitioner.cc check abort — so the probe must run out-of-process.
+_PARTIAL_MANUAL_PROBE = """
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from tpusystem.parallel.mesh import force_host_platform, shard_map
+force_host_platform(4)
+mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2), ('stage', 'model'))
+x = jnp.ones((8, 8), jnp.float32)
+w = jax.device_put(jnp.ones((8, 8), jnp.float32),
+                   NamedSharding(mesh, P(None, 'model')))
+body = lambda xs, ws: lax.ppermute(xs @ ws, 'stage', [(0, 1), (1, 0)])
+mapped = shard_map(body, mesh=mesh,
+                   in_specs=(P('stage', None), P(None, None)),
+                   out_specs=P('stage', None), check_vma=False,
+                   axis_names=frozenset({'stage'}))
+print(float(jax.jit(mapped)(x, w).sum()))
+"""
+
+
+@functools.lru_cache(maxsize=None)
+def partial_manual_skip_reason() -> str | None:
+    """Capability probe: can this jaxlib lower a partially-manual
+    ``shard_map`` (the PartitionId instruction under SPMD) on CPU?
+
+    Returns ``None`` when it can, else a reason string carrying the
+    probe's error line — made for ``pytest.mark.skipif`` on the PP x TP
+    tests that exercise the pipeline's partial-manual path (see
+    :func:`shard_map`'s legacy-path caveat). Runs the probe in a
+    subprocess because failing jaxlibs may abort the whole process with
+    a fatal ``spmd_partitioner.cc`` check rather than raise. The result
+    is cached in-process (lru_cache) AND on disk keyed by the
+    jax/jaxlib/python versions, so the ~6 s probe subprocess runs once
+    per installation, not once per pytest invocation.
+    """
+    import pathlib
+    import subprocess
+    import sys
+    import tempfile
+    import jaxlib
+    key = (f"{jax.__version__}-{getattr(jaxlib, '__version__', '?')}-"
+           f'py{sys.version_info[0]}.{sys.version_info[1]}')
+    cache = (pathlib.Path(tempfile.gettempdir())
+             / f'tpusystem-partial-manual-{key}.txt')
+    try:
+        cached = cache.read_text()
+        return None if cached == 'ok' else cached
+    except OSError:
+        pass
+    repo_root = pathlib.Path(__file__).resolve().parents[2]
+    try:
+        probe = subprocess.run(
+            [sys.executable, '-c', _PARTIAL_MANUAL_PROBE],
+            capture_output=True, text=True, timeout=600,
+            cwd=str(repo_root))
+    except (OSError, subprocess.TimeoutExpired) as error:
+        return f'partial-manual shard_map probe could not run: {error}'
+    if probe.returncode == 0:
+        reason = None
+    else:
+        lines = [line.strip() for line in
+                 (probe.stderr + '\n' + probe.stdout).splitlines()
+                 if line.strip()]
+        detail = next((line for line in lines if 'PartitionId' in line
+                       or 'spmd_partitioner' in line),
+                      lines[-1] if lines else f'exit code {probe.returncode}')
+        reason = ('this jaxlib cannot lower partial-manual shard_map '
+                  f'(PartitionId under SPMD) on CPU: {detail[:200]}')
+    try:
+        cache.write_text('ok' if reason is None else reason)
+    except OSError:
+        pass
+    return reason
 
 
 def force_host_platform(n_devices: int = 8) -> None:
