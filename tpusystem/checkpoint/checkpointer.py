@@ -1,4 +1,4 @@
-"""Async sharded pytree checkpointer (Orbax-backed).
+"""Async sharded pytree checkpointer (Orbax-backed), preemption-hardened.
 
 Replaces the reference's ``torch.save(model.nn, f'{root}/{id}.pth')`` +
 ``load_state_dict`` pair (``examples/tinysys/tinysys/repository.py:13-17``)
@@ -10,18 +10,41 @@ with a TPU-appropriate design:
 * **async**: the save is snapshotted and committed in the background, so the
   training loop resumes immediately (the analogue of keeping the bus off the
   hot path — SURVEY.md §7.3);
-* **versioned by epoch**: one directory per identity, one step dir per epoch,
-  enabling the reference's create-or-resume decision
-  (``.../services/compilation.py:41-57``) via :meth:`Checkpointer.latest`.
+* **versioned by step**: one directory per identity, one step dir per
+  version — historically one per *epoch*; with step-granular resume the
+  version is any monotonic global step. :meth:`Checkpointer.latest` drives
+  the reference's create-or-resume decision
+  (``.../services/compilation.py:41-57``);
+* **preemption-safe**: a save may be torn mid-write by a kill — restore and
+  latest :meth:`verify` every candidate step dir and *fall back* to the
+  newest committed one (logging what was discarded) instead of crashing on
+  a truncated directory; :meth:`fence` records the newest committed step in
+  a monotonic commit-fence file, the durability receipt an emergency
+  (SIGTERM) checkpoint needs before the process exits.
+
+Host-side resume metadata — the data-loader cursor, wall-clock, anything
+JSON-able — rides each step as ``extras`` (:meth:`save` /
+:meth:`extras`): device arrays go through Orbax, the cursor through an
+atomically-renamed sidecar, and :meth:`resume` returns both.
 """
 
 from __future__ import annotations
 
+import json
+import logging
+import os
 import pathlib
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+logger = logging.getLogger('tpusystem.checkpoint')
+
+# sidecar directories under {root}/{identity}; the leading dot keeps them
+# out of Orbax's integer step scan
+_EXTRAS_DIR = '.extras'
+_FENCE_FILE = '.fence'
 
 
 def abstract_like(tree: Any) -> Any:
@@ -38,10 +61,19 @@ def abstract_like(tree: Any) -> Any:
     return jax.tree.map(spec, tree)
 
 
-class Checkpointer:
-    """Identity-keyed, epoch-versioned pytree store.
+def _atomic_write(path: pathlib.Path, text: str) -> None:
+    """Write-then-rename so readers never see a torn file (the same
+    atomicity discipline Orbax applies to whole step dirs)."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    staging = path.with_name(path.name + '.tmp')
+    staging.write_text(text)
+    os.replace(staging, path)
 
-    Layout: ``{root}/{identity}/{epoch}/...`` — the identity is the registry
+
+class Checkpointer:
+    """Identity-keyed, step-versioned pytree store.
+
+    Layout: ``{root}/{identity}/{step}/...`` — the identity is the registry
     hash of the aggregate (deterministic across hosts and restarts), so every
     worker independently computes the same directory and the restore decision
     needs no coordination.
@@ -51,7 +83,7 @@ class Checkpointer:
                  keep_every: int | None = None,
                  async_save: bool = True) -> None:
         """``max_to_keep`` bounds the rolling window; ``keep_every`` pins
-        every Nth epoch forever in addition (GC policy: a long run keeps
+        every Nth step forever in addition (GC policy: a long run keeps
         recent checkpoints for resume plus periodic ones for analysis
         /rollback instead of losing all history to the window)."""
         self.root = pathlib.Path(root).absolute()
@@ -70,14 +102,150 @@ class Checkpointer:
                 self.root / identity, options=options)
         return self._managers[identity]
 
-    def save(self, identity: str, epoch: int, state: Any) -> None:
+    def save(self, identity: str, epoch: int, state: Any, *,
+             extras: Any | None = None) -> None:
         """Snapshot ``state`` under (identity, epoch); returns immediately.
 
-        With ``async_save`` the device buffers are copied out synchronously
-        (cheap) and serialized in a background thread; call :meth:`wait` (or
-        rely on save-on-next-epoch barriers) before reading the files.
+        ``epoch`` is the version number — an epoch index or a global step;
+        versions must be saved in increasing order. With ``async_save`` the
+        device buffers are copied out synchronously (cheap) and serialized in
+        a background thread; call :meth:`wait` (or rely on save-on-next-epoch
+        barriers) before reading the files, and :meth:`fence` for a
+        durability receipt.
+
+        ``extras`` is optional host-side resume metadata (anything
+        JSON-able: the data-loader cursor, host step, wall time). It is
+        written synchronously to an atomically-renamed sidecar — it never
+        blocks on the array serialization — and comes back via
+        :meth:`extras` / :meth:`resume`.
         """
+        if extras is not None:
+            # sidecar BEFORE the array commit: a kill between the two must
+            # not leave a committed step with no cursor (an orphan sidecar
+            # for a never-committed step is harmless and pruned later)
+            _atomic_write(self._extras_path(identity, epoch),
+                          json.dumps(extras))
         self._manager(identity).save(epoch, args=ocp.args.StandardSave(state))
+        self._prune_extras(identity)
+
+    def _extras_path(self, identity: str, epoch: int) -> pathlib.Path:
+        return self.root / identity / _EXTRAS_DIR / f'{int(epoch)}.json'
+
+    def _prune_extras(self, identity: str) -> None:
+        """Drop sidecars whose step dir Orbax's GC already collected.
+
+        Only steps *below* the newest on-disk step are candidates: an async
+        save still in flight has no committed dir yet (its tmp dir is not
+        integer-named), and its sidecar — written synchronously — must
+        survive until the commit lands."""
+        extras_dir = self.root / identity / _EXTRAS_DIR
+        if not extras_dir.is_dir():
+            return
+        on_disk = self._disk_steps(identity)
+        if not on_disk:
+            return
+        live = set(on_disk)
+        for sidecar in extras_dir.glob('*.json'):
+            if not sidecar.stem.isdigit():
+                continue
+            step = int(sidecar.stem)
+            if step < on_disk[-1] and step not in live:
+                sidecar.unlink(missing_ok=True)
+
+    def extras(self, identity: str, epoch: int) -> Any | None:
+        """Host-side resume metadata saved with (identity, epoch), or None."""
+        path = self._extras_path(identity, epoch)
+        if not path.is_file():
+            return None
+        return json.loads(path.read_text())
+
+    # ------------------------------------------------------------------
+    # integrity: verify / committed steps / fence
+
+    def _disk_steps(self, identity: str) -> list[int]:
+        """Integer-named step dirs on disk, ascending — committed or not.
+
+        Read from the filesystem (not the manager's cached step list) so a
+        fresh process sees exactly what a kill left behind, including torn
+        dirs a crashed writer never renamed away.
+        """
+        home = self.root / identity
+        if not home.is_dir():
+            return []
+        return sorted(int(entry.name) for entry in home.iterdir()
+                      if entry.is_dir() and entry.name.isdigit())
+
+    def verify(self, identity: str, epoch: int) -> bool:
+        """Integrity probe: is (identity, epoch) a *committed* checkpoint?
+
+        A committed Orbax step dir carries a ``_CHECKPOINT_METADATA`` commit
+        marker and at least one item payload with its ``_METADATA``
+        manifest. A dir missing either is incomplete — a save torn by a
+        preemption mid-write, or a partial copy — and must be skipped by
+        the resume path, never handed to a restore that would crash on it.
+
+        Orbax's public ``is_checkpoint_finalized`` is consulted where
+        available but cannot replace the marker probe: on the pinned 0.7.0
+        it only checks the commit-by-rename naming convention, so a
+        planted/truncated dir with a plain integer name passes it.
+        """
+        step_dir = self.root / identity / str(int(epoch))
+        if not step_dir.is_dir():
+            return False
+        is_tmp = getattr(ocp.utils, 'is_tmp_checkpoint', None)
+        if is_tmp is not None and is_tmp(step_dir):
+            return False
+        if not (step_dir / '_CHECKPOINT_METADATA').is_file():
+            return False
+        items = [entry for entry in step_dir.iterdir() if entry.is_dir()]
+        if not items:
+            return False
+        return all((item / '_METADATA').is_file() for item in items)
+
+    def committed(self, identity: str) -> list[int]:
+        """Committed (verified) steps for the identity, ascending; torn or
+        corrupt step dirs are skipped and logged."""
+        steps = []
+        for step in self._disk_steps(identity):
+            if self.verify(identity, step):
+                steps.append(step)
+            else:
+                logger.warning(
+                    'checkpoint %s/%s/%d is incomplete or corrupt; skipping',
+                    self.root, identity, step)
+        return steps
+
+    def fence(self, identity: str) -> int | None:
+        """Commit fence: block until in-flight saves land, then record the
+        newest committed step in a monotonic fence file.
+
+        The fence is the durability receipt of the preemption path — an
+        emergency save followed by ``fence()`` guarantees the checkpoint is
+        on disk before the process exits with a restartable code. The
+        recorded step never decreases: a reader of :meth:`fenced` can trust
+        that at least that step survived, whatever a later kill tore.
+        """
+        self.wait()
+        steps = self.committed(identity)
+        newest = steps[-1] if steps else None
+        if newest is None:
+            return self.fenced(identity)
+        previous = self.fenced(identity)
+        if previous is not None and previous > newest:
+            return previous
+        _atomic_write(self.root / identity / _FENCE_FILE,
+                      json.dumps({'step': newest}))
+        return newest
+
+    def fenced(self, identity: str) -> int | None:
+        """The fenced (guaranteed-durable) step, or None before any fence."""
+        path = self.root / identity / _FENCE_FILE
+        if not path.is_file():
+            return None
+        return int(json.loads(path.read_text())['step'])
+
+    # ------------------------------------------------------------------
+    # restore
 
     def restore(self, identity: str, target: Any, epoch: int | None = None) -> Any:
         """Restore the pytree saved under (identity, epoch or latest).
@@ -85,26 +253,96 @@ class Checkpointer:
         ``target`` may be a concrete pytree (its shapes/dtypes/shardings are
         used, see :func:`abstract_like`) or an abstract one. Each shard is
         read straight onto its mesh device.
+
+        An **explicit** ``epoch`` must exist and verify — a missing or
+        corrupt one raises :class:`FileNotFoundError` naming the committed
+        epochs, so the caller sees what it *can* restore instead of an
+        opaque Orbax error. With ``epoch=None`` the newest committed step is
+        used, falling back over torn/corrupt dirs (each discard logged).
         """
-        manager = self._manager(identity)
-        if epoch is None:
-            epoch = manager.latest_step()
-        if epoch is None:
-            raise FileNotFoundError(f'no checkpoint for identity {identity!r} under {self.root}')
         abstract = abstract_like(target)
-        return manager.restore(epoch, args=ocp.args.StandardRestore(abstract))
+        if epoch is not None:
+            if not self.verify(identity, epoch):
+                available = self.committed(identity)
+                raise FileNotFoundError(
+                    f'no committed checkpoint for identity {identity!r} at '
+                    f'epoch {epoch} under {self.root} '
+                    f'(committed epochs: {available or "none"})')
+            return self._manager(identity).restore(
+                epoch, args=ocp.args.StandardRestore(abstract))
+        return self._restore_newest(identity, abstract)[0]
+
+    def _restore_newest(self, identity: str, abstract: Any) -> tuple[Any, int]:
+        """Restore the newest committed step, falling back over steps whose
+        payload fails to load despite passing the probe (each discard
+        logged); returns ``(state, step)``.
+
+        If *every* committed step fails, the last underlying error is
+        re-raised — a wrong restore target (model-config drift since the
+        save) fails every step identically, and masking that as
+        FileNotFoundError would let a create-or-resume caller silently
+        reinitialize over good checkpoints.
+        """
+        candidates = self.committed(identity)
+        errors: list[tuple[int, Exception]] = []
+        for step in reversed(candidates):
+            try:
+                state = self._manager(identity).restore(
+                    step, args=ocp.args.StandardRestore(abstract))
+                return state, step
+            except Exception as error:  # torn payload that passed the probe
+                errors.append((step, error))
+                logger.warning(
+                    'restore of %s/%s/%d failed (%s); falling back to the '
+                    'previous committed step', self.root, identity, step, error)
+        if errors:
+            raise errors[-1][1]
+        raise FileNotFoundError(
+            f'no restorable checkpoint for identity {identity!r} under '
+            f'{self.root}')
+
+    def resume(self, identity: str, target: Any) -> tuple[Any, int, Any | None]:
+        """One-call resume: ``(state, step, extras)`` from the newest
+        committed checkpoint — the restart half of the preemption cycle.
+
+        Uses the same newest-to-oldest fallback as the implicit
+        :meth:`restore`: a step whose payload is torn despite a passing
+        probe is logged and skipped, not crashed on. ``extras`` is whatever
+        host metadata :meth:`save` stored (e.g. the data-loader cursor to
+        :meth:`~tpusystem.data.Loader.seek`), or None.
+        """
+        state, step = self._restore_newest(identity, abstract_like(target))
+        return state, step, self.extras(identity, step)
 
     def latest(self, identity: str) -> int | None:
-        """Latest checkpointed epoch for the identity, or ``None`` if fresh.
+        """Latest *committed* step for the identity, or ``None`` if fresh.
 
-        This is the TPU analogue of the reference's DB lookup deciding
-        create-vs-resume (``.../services/compilation.py:41-57``).
+        Torn or corrupt step dirs (a kill mid-save, a truncated copy) are
+        skipped with a logged warning — the create-or-resume decision
+        (``.../services/compilation.py:41-57``) must land on a checkpoint
+        that will actually restore. For allocating the *next* version
+        number use :meth:`newest` — an async save still in flight has no
+        committed dir yet and must not have its step reused.
         """
-        return self._manager(identity).latest_step()
+        steps = self.committed(identity)
+        return steps[-1] if steps else None
+
+    def newest(self, identity: str) -> int | None:
+        """Newest *known* step — on disk (committed or torn) or still in
+        flight as an async save. Version allocation only
+        (``Repository.store``'s auto increment), never resume: a torn dir
+        still owns its number (saving over it would collide) and an
+        in-flight step has nothing readable on disk yet, so no integrity
+        probe runs here."""
+        on_disk = self._disk_steps(identity)
+        candidates = [step for step in (on_disk[-1] if on_disk else None,
+                                        self._manager(identity).latest_step())
+                      if step is not None]
+        return max(candidates) if candidates else None
 
     def epochs(self, identity: str) -> list[int]:
-        """All retained epochs for the identity, ascending."""
-        return sorted(self._manager(identity).all_steps())
+        """All retained committed epochs for the identity, ascending."""
+        return self.committed(identity)
 
     def wait(self) -> None:
         """Block until every in-flight async save has committed."""

@@ -37,14 +37,21 @@ class Repository:
         self.checkpointer = Checkpointer(root, max_to_keep=max_to_keep,
                                          async_save=async_save)
 
-    def store(self, aggregate: Any, epoch: int | None = None) -> None:
-        """Persist ``aggregate.state`` under its identity."""
+    def store(self, aggregate: Any, epoch: int | None = None, *,
+              extras: Any | None = None) -> None:
+        """Persist ``aggregate.state`` under its identity.
+
+        ``extras`` is optional JSON-able host metadata (e.g. a data-loader
+        cursor for step-granular resume) stored alongside the pytree."""
         if epoch is None:
             epoch = getattr(aggregate, 'epoch', None)
         if epoch is None:
-            latest = self.checkpointer.latest(str(aggregate.id))
-            epoch = 0 if latest is None else latest + 1
-        self.checkpointer.save(str(aggregate.id), epoch, aggregate.state)
+            # newest(), not latest(): an async save still in flight owns
+            # its step number even though nothing committed yet
+            newest = self.checkpointer.newest(str(aggregate.id))
+            epoch = 0 if newest is None else newest + 1
+        self.checkpointer.save(str(aggregate.id), epoch, aggregate.state,
+                               extras=extras)
 
     def restore(self, aggregate: Any, epoch: int | None = None) -> None:
         """Load the stored pytree back into ``aggregate.state`` in place.
@@ -59,6 +66,21 @@ class Repository:
     def latest(self, aggregate: Any) -> int | None:
         """Latest stored epoch for this aggregate, or ``None`` if fresh."""
         return self.checkpointer.latest(str(aggregate.id))
+
+    def resume(self, aggregate: Any) -> tuple[int, Any | None]:
+        """Restore the newest committed checkpoint into ``aggregate.state``
+        and return ``(step, extras)`` — the restart half of preemption
+        recovery (extras carries e.g. the loader cursor)."""
+        state, step, extras = self.checkpointer.resume(
+            str(aggregate.id), aggregate.state)
+        aggregate.state = state
+        return step, extras
+
+    def fence(self, aggregate: Any) -> int | None:
+        """Block until pending saves commit, then advance the monotonic
+        commit fence for this aggregate — the emergency-checkpoint
+        durability receipt (see :meth:`Checkpointer.fence`)."""
+        return self.checkpointer.fence(str(aggregate.id))
 
     def wait(self) -> None:
         self.checkpointer.wait()

@@ -87,15 +87,50 @@ class Loader:
         self.sharding = sharding
         self.prefetch = prefetch
         self._epoch = 0
+        self._skip = 0
+        self._position = {'epoch': 0, 'batch': 0}
 
     def __len__(self) -> int:
         n, b = len(self.dataset), self.batch_size
         return n // b if self.drop_remainder else (n + b - 1) // b
 
-    def _order(self) -> np.ndarray:
+    def state(self) -> dict:
+        """Resume cursor: the position of the **next batch to be yielded**.
+
+        ``{'epoch': e, 'batch': b}`` means batch ``b`` of epoch ``e`` has not
+        been consumed yet. The cursor advances as batches are *yielded* (not
+        as the prefetch thread produces them), so a checkpoint taken after
+        step N records exactly the data step N+1 should start from. The
+        cursor is JSON-able on purpose — it rides a checkpoint's host-side
+        ``extras`` (:meth:`tpusystem.checkpoint.Checkpointer.save`)."""
+        return dict(self._position)
+
+    def seek(self, cursor: dict) -> 'Loader':
+        """Position the next ``__iter__`` at ``cursor`` (from :meth:`state`).
+
+        The batch order of an epoch is a pure function of ``(seed, epoch)``,
+        so a fresh process seeking a saved cursor regenerates the *identical*
+        permutation and skips the already-consumed batches instead of
+        replaying the epoch — the step-granular half of preemption resume.
+        A cursor at or past the epoch end normalizes to the next epoch."""
+        epoch, batch = int(cursor['epoch']), int(cursor['batch'])
+        if batch < 0:
+            raise ValueError(f'cursor batch must be >= 0, got {batch}')
+        batches = len(self)
+        if batches and batch >= batches:
+            epoch, batch = epoch + batch // batches, batch % batches
+        self._epoch = epoch
+        self._skip = batch
+        self._position = {'epoch': epoch, 'batch': batch}
+        return self
+
+    def _order(self, epoch: int | None = None) -> np.ndarray:
+        """Epoch's batch order — a pure function of ``(seed, epoch)``, which
+        is what makes a :meth:`seek`-ed resume replay-identical."""
+        epoch = self._epoch if epoch is None else epoch
         indices = np.arange(len(self.dataset))
         if self.shuffle:
-            rng = np.random.default_rng(self.seed + self._epoch)
+            rng = np.random.default_rng(self.seed + epoch)
             rng.shuffle(indices)
         return indices
 
@@ -117,13 +152,19 @@ class Loader:
         queue operation polls a stop flag, so an abandoned iterator
         never leaves a blocked producer behind.
         """
-        order = self._order()
+        epoch = self._epoch
+        skip = self._skip
+        self._skip = 0
         self._epoch += 1
+        order = self._order(epoch)
         spans = [order[start:start + self.batch_size]
                  for start in range(0, len(order), self.batch_size)]
         if self.drop_remainder and spans and len(spans[-1]) < self.batch_size:
             spans.pop()
+        self._position = {'epoch': epoch, 'batch': skip}
+        spans = spans[skip:]          # seek(): already-consumed batches
         if not spans:
+            self._position = {'epoch': epoch + 1, 'batch': 0}
             return
         buffer: queue.Queue = queue.Queue(maxsize=max(self.prefetch, 1))
         stop = threading.Event()
@@ -153,12 +194,19 @@ class Loader:
                                   name='loader-prefetch')
         thread.start()
         try:
+            consumed = skip
             while True:
                 item = buffer.get()
                 if item is done:
+                    self._position = {'epoch': epoch + 1, 'batch': 0}
                     break
                 if isinstance(item, _PrefetchError):
                     raise item.error
+                # advance BEFORE yielding: the consumer checkpoints from
+                # inside the loop body (the generator is suspended here), so
+                # state() must already name the batch AFTER this one
+                consumed += 1
+                self._position = {'epoch': epoch, 'batch': consumed}
                 yield item
         finally:
             stop.set()

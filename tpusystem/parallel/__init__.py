@@ -20,7 +20,11 @@ from tpusystem.parallel.overlap import (
 from tpusystem.parallel.pipeline import (PipelineParallel,
                                          compose_stacked_rules,
                                          pipeline_apply, pipeline_train)
-from tpusystem.parallel.recovery import (LOST_WORKER_EXIT, WorkerLostError,
+from tpusystem.parallel.chaos import (ChaosHub, ChaosTransport, DieAtStep,
+                                      Faults, WorkerKilled)
+from tpusystem.parallel.recovery import (LOST_WORKER_EXIT, PREEMPTED_EXIT,
+                                         RESTART_EXITS, Preempted,
+                                         WorkerLostError, exit_for_restart,
                                          recovery_consumer)
 from tpusystem.parallel.sharding import (
     DataParallel, FullyShardedDataParallel, ShardingPolicy, TensorParallel,
@@ -38,6 +42,8 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'TcpTransport', 'DistributedProducer', 'DistributedPublisher',
            'WorkerLost', 'WorkerJoined',
            'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT',
+           'Preempted', 'PREEMPTED_EXIT', 'RESTART_EXITS', 'exit_for_restart',
+           'Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
            'all_reduce_sum', 'all_reduce_mean', 'all_gather',
            'reduce_scatter', 'all_to_all', 'ring_shift',
            'ring_shift_chunked', 'axis_index', 'axis_size',

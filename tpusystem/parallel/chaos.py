@@ -1,0 +1,217 @@
+"""Deterministic fault injection for the control plane (chaos harness).
+
+"Handles as many scenarios as you can imagine" (ROADMAP) is only true of
+scenarios that are *exercised*. This module wraps the real
+:class:`~tpusystem.parallel.multihost.TcpTransport` / ``Hub`` stack — not a
+mock of it — with **seeded, deterministic** fault injection, so every
+failure path the recovery machinery claims to survive is a replayable test
+case instead of a hand-crafted one-off:
+
+* dropped frames (events vanish in flight — the at-most-once contract);
+* delayed frames (reordering pressure on the hub's collective bookkeeping);
+* heartbeat stalls (a healthy-but-slow host crossing the liveness timeout);
+* mid-collective socket kills (the crashed-host signature: EOF, no 'bye');
+* worker death at a chosen global step (:class:`DieAtStep` — the
+  kill-at-step-k → restart → step-granular-resume drill).
+
+Determinism: every fault decision is drawn in frame order from one
+``random.Random(seed)`` per :class:`Faults` instance, and frames of one
+transport are serialized by its send lock — same seed, same faults. Frame
+kinds carrying pod agreement (``hello``/``bye``/collective results) default
+to spared so a scenario targets the traffic it means to; widen ``kinds``
+deliberately when the test wants to hurt collectives themselves.
+
+The harness is control-plane only, by design: the data plane (XLA
+collectives) fails as a unit with the process, which is exactly what
+:class:`DieAtStep` simulates.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from tpusystem.parallel.multihost import Hub, TcpTransport
+
+__all__ = ['Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled']
+
+
+@dataclass
+class Faults:
+    """Seeded fault plan consulted once per frame, in send order.
+
+    Args:
+        seed: the determinism anchor — same seed, same decisions.
+        drop: probability a matching frame is silently discarded.
+        delay: probability a matching frame is held for ``delay_seconds``.
+        delay_seconds: hold time for delayed frames.
+        kinds: frame kinds eligible for faults (None: every kind not in
+            ``spare``). Transport frame kinds: ``event``, ``reduce``,
+            ``gather``, ``hb``; hub fanout kinds add ``result``, ``lost``,
+            ``joined``.
+        spare: kinds never faulted. By default: handshake/teardown frames
+            (drop those and a scenario tests the dialer's retry loop,
+            usually not what it meant); ``result`` (dropping a collective's
+            result fanout wedges every waiting rank into its full timeout
+            — target it explicitly via ``kinds`` when a scenario wants
+            that); and ``hb``: heartbeats ride their own thread, so
+            probabilistic faults on them would interleave RNG draws
+            scheduler-dependently and break the same-seed-same-faults
+            contract — fault heartbeats with the *scripted*
+            :meth:`stall_heartbeats` instead.
+    """
+
+    seed: int = 0
+    drop: float = 0.0
+    delay: float = 0.0
+    delay_seconds: float = 0.02
+    kinds: tuple[str, ...] | None = None
+    spare: tuple[str, ...] = ('hello', 'bye', 'peer', 'standby', 'rejected',
+                              'result', 'hb')
+
+    def __post_init__(self) -> None:
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self._stall_until = 0.0
+        self.dropped: list[str] = []    # observability for assertions
+        self.delayed: list[str] = []
+
+    def decide(self, kind: str) -> float | None:
+        """One decision: None = drop the frame, 0.0 = pass, >0 = delay.
+
+        An explicit ``kinds`` list overrides ``spare`` — naming a kind is
+        the opt-in for faulting even default-spared traffic (``result``,
+        ``hb``). Draws are taken for every eligible frame whether or not a
+        fault fires, so the decision stream depends only on the frame
+        sequence — not on which probabilities are enabled."""
+        if self.kinds is not None:
+            if kind not in self.kinds:
+                return 0.0
+        elif kind in self.spare:
+            return 0.0
+        with self._lock:
+            roll = self._rng.random()
+            if roll < self.drop:
+                self.dropped.append(kind)
+                return None
+            if roll < self.drop + self.delay:
+                self.delayed.append(kind)
+                return self.delay_seconds
+        return 0.0
+
+    def stall_heartbeats(self, seconds: float) -> None:
+        """Swallow outbound heartbeats for ``seconds`` — a host that is
+        alive but unresponsive (GC pause, hung NFS, overloaded NIC), the
+        scenario the hub's liveness timeout must classify as lost."""
+        with self._lock:
+            self._stall_until = time.monotonic() + seconds
+
+    @property
+    def heartbeats_stalled(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._stall_until
+
+
+class ChaosTransport(TcpTransport):
+    """A :class:`TcpTransport` whose outbound frames pass through a
+    :class:`Faults` plan. The wire protocol, hub, and recovery machinery
+    are the real ones — only the network misbehaves."""
+
+    def __init__(self, address, rank: int, size: int, *,
+                 faults: Faults | None = None, **kwargs: Any):
+        self.faults = faults if faults is not None else Faults()
+        super().__init__(address, rank, size, **kwargs)
+
+    def _send(self, frame: tuple, op_key: tuple | None = None) -> None:
+        kind = frame[0]
+        if kind == 'hb' and self.faults.heartbeats_stalled:
+            return                       # the beat never leaves the host
+        verdict = self.faults.decide(kind)
+        if verdict is None:
+            return                       # dropped on the (virtual) wire
+        if verdict > 0:
+            time.sleep(verdict)
+        super()._send(frame, op_key)
+
+    def kill(self) -> None:
+        """Abrupt socket death — the crashed-host signature the hub must
+        classify as a loss (EOF with no 'bye'), usable mid-collective.
+
+        Unlike :meth:`close`, nothing is flushed and no teardown runs: the
+        transport object stays around exactly like the OS socket of a
+        SIGKILLed process would."""
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class ChaosHub(Hub):
+    """A :class:`Hub` whose fanout passes through a :class:`Faults` plan —
+    faults on the router's side of the star (results and loss broadcasts
+    included, when ``kinds`` says so)."""
+
+    def __init__(self, size: int, *, faults: Faults | None = None,
+                 **kwargs: Any):
+        self.faults = faults if faults is not None else Faults()
+        super().__init__(size, **kwargs)
+
+    def _fanout(self, frame: tuple, exclude: int | None = None,
+                live_only: bool = False) -> None:
+        verdict = self.faults.decide(frame[0])
+        if verdict is None:
+            return
+        if verdict > 0:
+            time.sleep(verdict)
+        super()._fanout(frame, exclude=exclude, live_only=live_only)
+
+
+class WorkerKilled(RuntimeError):
+    """In-process stand-in for a worker death (see :class:`DieAtStep`)."""
+
+    def __init__(self, step: int):
+        super().__init__(f'worker scripted to die at step {step}')
+        self.step = step
+
+
+@dataclass
+class DieAtStep:
+    """Scripted worker death at a chosen global step.
+
+    Call it with the just-completed step number from the training loop::
+
+        die = DieAtStep(step=7)                # in-process: raises
+        for batch in loader:
+            state, _ = step(state, *batch)
+            checkpointer.save(identity, state.global_step, state, ...)
+            die(state.global_step)
+
+    ``action='raise'`` (default) raises :class:`WorkerKilled` — the
+    in-process form, letting a test's "restart" run in the same process.
+    ``action='exit'`` calls ``os._exit(code)`` — the cross-process form: no
+    'bye' frame, no atexit, no flushing; the genuine article for
+    subprocess chaos tests. A callable ``action`` runs verbatim (e.g.
+    ``transport.kill`` to sever just the control plane).
+    """
+
+    step: int
+    action: str | Callable[[], None] = 'raise'
+    code: int = 1
+    fired: bool = field(default=False, init=False)
+
+    def __call__(self, current_step: int) -> None:
+        if self.fired or current_step != self.step:
+            return
+        self.fired = True
+        if callable(self.action):
+            self.action()
+        elif self.action == 'exit':
+            os._exit(self.code)
+        else:
+            raise WorkerKilled(self.step)

@@ -609,6 +609,12 @@ class TcpTransport:
                 frame = None
             if frame is None:
                 if self._closed.is_set():
+                    # the transport was closed with collectives still in
+                    # flight (pod teardown races a waiting thread): fail
+                    # them typed and fast, never leave a waiter to ride
+                    # out its full collective timeout into a raw
+                    # queue.Empty (regression: test_chaos.py::TestClose)
+                    self._abandon()
                     return
                 if not self._failover():
                     self._abandon()
@@ -728,6 +734,10 @@ class TcpTransport:
                 self._results.pop(op_key, None)
                 self._pending_sends.pop(op_key, None)
         if result is _FAILED_OVER:
+            if self._closed.is_set():
+                raise ControlPlaneFailover(
+                    f'rank {self.rank}: transport closed while this '
+                    f'collective was in flight')
             raise ControlPlaneFailover(
                 f'rank {self.rank}: the active hub died while this '
                 f'collective was in flight; resynchronize at a safe point '
@@ -768,6 +778,11 @@ class TcpTransport:
         except OSError:
             pass
         self._sock.close()
+        # collectives other threads still have in flight can never
+        # complete now — fail them typed (ControlPlaneFailover) instead of
+        # leaving them to their full timeout; the recv loop does the same,
+        # but it may itself be gone already
+        self._abandon()
 
 
 def connect(address: tuple, world: World,

@@ -36,14 +36,19 @@ early-stop point.
 from __future__ import annotations
 
 import logging
+import signal as signal_module
 
 from tpusystem.parallel.multihost import WorkerJoined, WorkerLost
 from tpusystem.services.prodcon import Consumer
 
 logger = logging.getLogger('tpusystem.recovery')
 
-# conventional exit code a launcher can map to "restart me"
+# conventional exit codes a launcher maps to "restart me": 42 is a peer
+# loss (the mesh must re-form), 43 a preemption of THIS host (SIGTERM from
+# the scheduler); both resume from the last committed checkpoint
 LOST_WORKER_EXIT = 42
+PREEMPTED_EXIT = 43
+RESTART_EXITS = frozenset({LOST_WORKER_EXIT, PREEMPTED_EXIT})
 
 
 class WorkerLostError(RuntimeError):
@@ -55,6 +60,43 @@ class WorkerLostError(RuntimeError):
             'restart the job to resume from the last committed checkpoint')
         self.rank = rank
         self.last_seen = last_seen
+
+
+class Preempted(RuntimeError):
+    """The scheduler is evicting this host (SIGTERM or maintenance notice).
+
+    Raised on the host loop thread at the next ``runtime.sync()`` drain
+    point — never from inside the signal handler, where the job could be
+    mid-collective — so the epoch loop unwinds at a step boundary, fences
+    an emergency checkpoint, and exits with :data:`PREEMPTED_EXIT`::
+
+        try:
+            ... epoch loop with runtime.sync() ...
+        except (Preempted, WorkerLostError) as reason:
+            checkpointer.save(identity, state.global_step, state,
+                              extras=resume_extras(state, loader))
+            checkpointer.fence(identity)        # durability receipt
+            raise exit_for_restart(reason)
+    """
+
+    def __init__(self, signum: int):
+        name = signal_module.Signals(signum).name
+        super().__init__(
+            f'preempted by {name}; checkpoint-fence and exit '
+            f'{PREEMPTED_EXIT} so the scheduler restarts the job')
+        self.signum = signum
+
+
+def exit_for_restart(reason: BaseException) -> SystemExit:
+    """Map a recovery exception to its restartable ``SystemExit``.
+
+    ``raise exit_for_restart(error)`` ends the process with the exit code
+    the launcher contract recognizes (:data:`RESTART_EXITS`): the
+    scheduler relaunches the job and the resume path picks up from the
+    last committed checkpoint.
+    """
+    code = PREEMPTED_EXIT if isinstance(reason, Preempted) else LOST_WORKER_EXIT
+    return SystemExit(code)
 
 
 def recovery_consumer(policy: str = 'abort') -> Consumer:

@@ -22,25 +22,33 @@ correct in both worlds:
 
 Typical pod-ready epoch loop::
 
-    runtime = Runtime()                       # env-driven; Loopback off-pod
+    runtime = Runtime(preemption=True)        # env-driven; Loopback off-pod
     runtime.producer.register(logging_consumer())
     runtime.producer.register(tracking_consumer(), primary_only=True)
     runtime.producer.register(checkpoint_consumer())   # ALL hosts: saves are collective
-    for epoch in range(epochs):
-        try:
-            service.handle('iterate', model, loaders, metrics)
-            wants_stop = False
-        except StopIteration:      # unhandled stop event unwound from commit
-            wants_stop = True
-        runtime.sync()
-        if runtime.should_stop(wants_stop):
-            break
-    runtime.close()
+    runtime.producer.register(recovery_consumer())     # WorkerLost -> restart
+    try:
+        for epoch in range(epochs):
+            try:
+                service.handle('iterate', model, loaders, metrics)
+                wants_stop = False
+            except StopIteration:  # unhandled stop event unwound from commit
+                wants_stop = True
+            runtime.sync()         # Preempted / WorkerLostError raise here
+            if runtime.should_stop(wants_stop):
+                break
+    except (Preempted, WorkerLostError) as reason:
+        repository.store(model)                # emergency checkpoint
+        repository.fence(model)                # durability receipt
+        raise exit_for_restart(reason)         # scheduler restarts -> resume
+    finally:
+        runtime.close()
 """
 
 from __future__ import annotations
 
 import os
+import signal as signal_module
 
 from tpusystem.observe.ledger import EventLedger
 from tpusystem.parallel import multihost
@@ -48,6 +56,7 @@ from tpusystem.parallel.multihost import (
     DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
     World,
 )
+from tpusystem.parallel.recovery import Preempted
 
 
 def _control_address(coordinator: str | None,
@@ -113,6 +122,10 @@ class Runtime:
         heartbeat: seconds between liveness pings; a host silent for 4
             intervals surfaces as a ``WorkerLost`` event on every other
             host. ``None`` disables failure detection.
+        preemption: install the SIGTERM preemption handler
+            (:meth:`install_preemption_handler`) at construction. Off by
+            default — signal handlers can only be installed from the main
+            thread, and not every embedding owns the process's signals.
     """
 
     def __init__(self, coordinator: str | None = None, *,
@@ -120,8 +133,11 @@ class Runtime:
                  num_processes: int | None = None,
                  process_id: int | None = None,
                  ledger: bool = False,
-                 heartbeat: float | None = 10.0) -> None:
+                 heartbeat: float | None = 10.0,
+                 preemption: bool = False) -> None:
         coordinator = coordinator or os.environ.get('TPUSYSTEM_COORDINATOR')
+        self._preempt_signal: int | None = None
+        self._previous_handlers: dict = {}
         self.world: World = multihost.initialize(
             coordinator, num_processes, process_id)
         self.hub: Hub | None = None
@@ -138,19 +154,70 @@ class Runtime:
         self.publisher = DistributedPublisher(self.transport)
         self.ledger: EventLedger | None = (
             EventLedger().tap(self.producer) if ledger else None)
+        if preemption:
+            self.install_preemption_handler()
 
     @property
     def is_primary(self) -> bool:
         return self.world.is_primary
 
+    def install_preemption_handler(
+            self, *signals: int) -> None:
+        """Arm preemption detection: the given signals (default SIGTERM —
+        what TPU-VM maintenance events and most schedulers deliver) set a
+        flag, and the next :meth:`sync` raises
+        :class:`~tpusystem.parallel.recovery.Preempted` on the host loop
+        thread.
+
+        The handler itself only records the signal: raising from inside a
+        signal handler could land mid-collective or mid-save and tear
+        exactly the state the emergency checkpoint needs intact. The raise
+        happens at the :meth:`sync` drain point; when one epoch outlasts
+        the scheduler's kill grace window, poll :attr:`preempted` inside
+        the step loop and call :meth:`sync` when it trips (see
+        :meth:`sync`). Must be called from the main thread (a Python
+        signal-handling constraint); the previous handlers are restored by
+        :meth:`close`.
+        """
+        if not signals:
+            signals = (signal_module.SIGTERM,)
+
+        def on_signal(signum, frame):
+            self._preempt_signal = signum
+
+        for signum in signals:
+            previous = signal_module.signal(signum, on_signal)
+            # a re-install must not record our own handler as 'previous',
+            # or close() would leave it armed for the process's lifetime
+            self._previous_handlers.setdefault(signum, previous)
+
+    @property
+    def preempted(self) -> bool:
+        """True once a preemption signal arrived (sticky until the
+        :class:`Preempted` raise hands control to the exit path)."""
+        return self._preempt_signal is not None
+
     def sync(self) -> None:
         """Epoch-boundary housekeeping: deliver queued remote events on this
         thread, then (when enabled) verify the event hash-chain across
-        hosts. Call once per epoch — never per step."""
+        hosts. Call once per epoch — never unconditionally per step. Raises
+        :class:`~tpusystem.parallel.recovery.Preempted` (after the drain,
+        so queued events still deliver) when a preemption signal arrived
+        since the last sync.
+
+        When an epoch outlasts the scheduler's SIGTERM→SIGKILL grace
+        window, guard the inner loop with the cheap :attr:`preempted` flag
+        so the raise still lands at a step boundary::
+
+            if runtime.preempted:
+                runtime.sync()        # raises Preempted now, drained
+        """
         self.producer.drain()
         self.publisher.drain()
         if self.ledger is not None:
             self.ledger.verify(self.transport)
+        if self._preempt_signal is not None:
+            raise Preempted(self._preempt_signal)
 
     def should_stop(self, wants_stop: bool) -> bool:
         """Collective early-stop verdict: any host wanting out stops all
@@ -163,6 +230,15 @@ class Runtime:
         self.transport.barrier()
 
     def close(self) -> None:
+        try:
+            for signum, handler in self._previous_handlers.items():
+                signal_module.signal(signum, handler)
+            self._previous_handlers.clear()
+        except ValueError:
+            # close() on a non-main thread cannot touch signal dispositions
+            # (a Python constraint); never let that abort the transport/hub
+            # teardown below — the handler stays until the process exits
+            pass
         self.transport.close()
         if self.hub is not None:
             self.hub.close()

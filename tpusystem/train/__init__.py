@@ -1,4 +1,4 @@
-from tpusystem.train.state import TrainState
+from tpusystem.train.state import TrainState, resume_extras
 from tpusystem.train.step import (build_1f1b_train_step, build_eval_step,
                                   build_multi_eval_step, build_multi_step,
                                   build_train_step, flax_apply,
@@ -9,7 +9,7 @@ from tpusystem.train.losses import (ChunkedNextTokenLoss, CrossEntropyLoss,
 from tpusystem.train.metrics import Accuracy, Mean, Metric, Perplexity, TopKAccuracy
 from tpusystem.train.generate import generate, speculative_generate
 
-__all__ = ['TrainState', 'build_train_step', 'build_1f1b_train_step', 'build_eval_step',
+__all__ = ['TrainState', 'resume_extras', 'build_train_step', 'build_1f1b_train_step', 'build_eval_step',
            'build_multi_step', 'build_multi_eval_step', 'flax_apply',
            'grouped_batches',
            'init_state', 'Optimizer', 'SGD', 'Adam', 'AdamW',

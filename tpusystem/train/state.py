@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+__all__ = ['TrainState', 'resume_extras']
+
 
 class TrainState(struct.PyTreeNode):
     """Immutable training-state pytree.
@@ -48,3 +50,33 @@ class TrainState(struct.PyTreeNode):
         """Split the carried key; returns (state-with-new-key, subkey)."""
         rng, sub = jax.random.split(self.rng)
         return self.replace(rng=rng), sub
+
+    @property
+    def global_step(self) -> int:
+        """Host-side view of the step counter (forces one device sync —
+        checkpoint/logging cadence only, never per step)."""
+        return int(self.step)
+
+
+def resume_extras(state: TrainState, loader: Any = None, **extra: Any) -> dict:
+    """Host-side resume metadata to ride a checkpoint's ``extras``.
+
+    The device-side resumable state (params, optimizer slots, RNG key, step
+    counter) already lives *inside* the :class:`TrainState` pytree and is
+    checkpointed with it; what the restart cannot recompute is the host-side
+    position — which batches the data loader already consumed. This bundles
+    both halves' bookkeeping into one JSON-able dict::
+
+        ckpt.save(identity, state.global_step, state,
+                  extras=resume_extras(state, loader))
+        ...
+        state, step, extras = ckpt.resume(identity, state)
+        loader.seek(extras['cursor'])          # skip consumed batches
+
+    ``loader`` is anything with a ``state()`` cursor method
+    (:class:`tpusystem.data.Loader`); extra keyword pairs are stored
+    verbatim.
+    """
+    return {'step': int(state.step),
+            'cursor': None if loader is None else loader.state(),
+            **extra}
