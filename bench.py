@@ -3,8 +3,10 @@
 Prints the ``tp_ffn_overlap_speedup_vs_gspmd`` row first (the
 latency-hiding TP collectives A/B, ``benchmarks/tp_overlap.py headline``
 in a subprocess — virtual-mesh smoke on CPU, real numbers on multi-chip
-TPU; see BASELINE.md "tp_overlap protocol"), then the headline as the
-LAST JSON line (the one the driver parses):
+TPU; see BASELINE.md "tp_overlap protocol"), then the
+``sentinel_overhead`` row (steps/s with the in-graph divergence guard on
+vs off — the < 2% budget tracked in BENCH_*.json from day one), then the
+headline as the LAST JSON line (the one the driver parses):
 ``{"metric": ..., "value": N, "spread": N, "unit": ..., "vs_baseline": N}``.
 
 ``value`` is the **median of TRIALS (>= 3) timed runs** after a shared
@@ -87,56 +89,119 @@ def tp_overlap_row() -> None:
                       'note': f'probe failed: {note}'}))
 
 
-def main() -> None:
-    from tpusystem.models import GPT2
-    from tpusystem.train import (ChunkedNextTokenLoss, AdamW, build_train_step,
-                                 flax_apply, init_state)
+BATCH, SEQ = 16, 1024
 
-    batch, seq = 16, 1024
-    # Perf recipe (each measured on a v5e chip):
-    # - vocab padded 50257 -> 50304 (x128): the unpadded table mis-tiles the
-    #   MXU on the head matmul (~10% whole-step MFU);
-    # - Pallas flash attention for the single-chip run (1024/1024 tiles);
-    # - fused chunked LM loss (return_features): the [B*S, vocab] f32 logits
-    #   tensor is never materialized (~5% MFU, and unlocks batch >= 32);
-    # - 90 steps per jit call (lax.fori_loop): per-dispatch overhead through
-    #   the tunneled-TPU relay is ~7 ms (~5% of a 135 ms step) and the final
-    #   host sync costs another dispatch — amortized across the loop
-    #   (measured r2: 10 steps 0.498, 30 0.515, 60 0.519; r3: 90 edges 60
-    #   by ~0.3% and 120 is flat). Round 3 also keeps the flash kernels
-    #   seedless at dropout=0 (the in-kernel dropout path wires its seed
-    #   input only when active — a persistent SMEM arg cost ~0.5%).
+
+def bench_recipe():
+    """The headline 125M recipe, shared by every row that measures it.
+
+    Perf recipe (each measured on a v5e chip):
+    - vocab padded 50257 -> 50304 (x128): the unpadded table mis-tiles the
+      MXU on the head matmul (~10% whole-step MFU);
+    - Pallas flash attention for the single-chip run (1024/1024 tiles);
+    - fused chunked LM loss (return_features): the [B*S, vocab] f32 logits
+      tensor is never materialized (~5% MFU, and unlocks batch >= 32);
+    - many steps per jit call (lax.fori_loop): per-dispatch overhead through
+      the tunneled-TPU relay is ~7 ms (~5% of a 135 ms step) and the final
+      host sync costs another dispatch — amortized across the loop
+      (measured r2: 10 steps 0.498, 30 0.515, 60 0.519; r3: 90 edges 60
+      by ~0.3% and 120 is flat). Round 3 also keeps the flash kernels
+      seedless at dropout=0 (the in-kernel dropout path wires its seed
+      input only when active — a persistent SMEM arg cost ~0.5%).
+    """
+    from tpusystem.models import GPT2
+    from tpusystem.train import AdamW
+
     module = GPT2(dropout=0.0, attention='flash', vocab_size=50304,
                   return_features=True)
     optimizer = AdamW(lr=3e-4, grad_clip=1.0)
     tokens = jnp.asarray(
-        np.random.default_rng(0).integers(0, 50257, (batch, seq)),
+        np.random.default_rng(0).integers(0, 50257, (BATCH, SEQ)),
         jnp.int32)
+    return module, optimizer, tokens
+
+
+def looped_runner(step, steps: int):
+    """``steps`` train steps per dispatch, state donated in place in HBM."""
+    @partial(jax.jit, donate_argnums=0)
+    def run(state, tokens):
+        return jax.lax.fori_loop(
+            0, steps, lambda i, st: step(st, tokens, tokens)[0], state)
+    return run
+
+
+def timed_trials(run, state, tokens):
+    """Shared timing protocol: one warmup/compile dispatch, then TRIALS
+    timed runs — completion forced by :func:`materialize` every time
+    (``jax.block_until_ready`` returns early through the tunneled-TPU
+    relay). Returns ``(state, elapsed_trials)``; report the median and the
+    max-min spread so BENCH_r* deltas can be told from run-to-run noise."""
+    state = run(state, tokens)
+    materialize(state.params)
+    elapsed_trials = []
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        state = run(state, tokens)
+        materialize(state.params)
+        elapsed_trials.append(time.perf_counter() - start)
+    return state, elapsed_trials
+
+
+def sentinel_overhead_row() -> None:
+    """Print the in-graph guard's cost: steps/s with ``guard=`` on vs off
+    on the bench model (same 125M recipe and timing protocol as the
+    headline, fewer steps per arm), as ``{"metric": "sentinel_overhead",
+    "value": <fractional slowdown>}`` — the acceptance budget is < 0.02
+    (2%). Printed BEFORE the MFU headline so the driver's parsed last-line
+    metric is unchanged; never fails the run (probe errors print a
+    null-value row)."""
+    try:
+        from tpusystem.train import (ChunkedNextTokenLoss, Guard,
+                                     build_train_step, flax_apply, init_state)
+
+        steps = 12
+        module, optimizer, tokens = bench_recipe()
+        guard = Guard()
+
+        def arm_rate(guarded: bool) -> float:
+            step = build_train_step(
+                flax_apply(module), ChunkedNextTokenLoss(chunks=8), optimizer,
+                jit=False, guard=guard if guarded else None)
+            state = init_state(module, optimizer, tokens[:1, :8])
+            if guarded:
+                state = guard.arm(state)
+            _, elapsed = timed_trials(looped_runner(step, steps), state,
+                                      tokens)
+            return steps / sorted(elapsed)[len(elapsed) // 2]
+
+        off, on = arm_rate(False), arm_rate(True)
+        print(json.dumps({
+            'metric': 'sentinel_overhead',
+            'value': round(1.0 - on / off, 4),
+            'unit': 'fraction of steps/s',
+            'guard_on_steps_per_sec': round(on, 4),
+            'guard_off_steps_per_sec': round(off, 4),
+        }))
+    except Exception as error:  # never cost the headline its run
+        print(json.dumps({'metric': 'sentinel_overhead', 'value': None,
+                          'unit': 'fraction of steps/s',
+                          'note': f'probe failed: {str(error)[:160]}'}))
+
+
+def main() -> None:
+    from tpusystem.train import (ChunkedNextTokenLoss, build_train_step,
+                                 flax_apply, init_state)
+
+    batch, seq = BATCH, SEQ
+    module, optimizer, tokens = bench_recipe()
     state = init_state(module, optimizer, tokens[:1, :8])
     params_count = sum(leaf.size for leaf in jax.tree.leaves(state.params))
     step = build_train_step(flax_apply(module), ChunkedNextTokenLoss(chunks=8),
                             optimizer, jit=False)
 
     steps = 90
-
-    @partial(jax.jit, donate_argnums=0)   # in-place param/slot updates in HBM
-    def run(state, tokens):
-        return jax.lax.fori_loop(
-            0, steps, lambda i, st: step(st, tokens, tokens)[0], state)
-
-    # warmup / compile. NOTE: force completion by materializing a value —
-    # jax.block_until_ready returns early through the tunneled-TPU relay.
-    state = run(state, tokens)
-    float(jax.tree.leaves(state.params)[0].sum())
-
-    # median-of-TRIALS with the max-min range: BENCH_r* deltas smaller
-    # than the printed spread are the sweep's own noise, not a change
-    elapsed_trials = []
-    for _ in range(TRIALS):
-        start = time.perf_counter()
-        state = run(state, tokens)
-        float(jax.tree.leaves(state.params)[0].sum())
-        elapsed_trials.append(time.perf_counter() - start)
+    state, elapsed_trials = timed_trials(looped_runner(step, steps), state,
+                                         tokens)
     elapsed = sorted(elapsed_trials)[len(elapsed_trials) // 2]
 
     tokens_per_step = batch * seq
@@ -174,4 +239,5 @@ def main() -> None:
 
 if __name__ == '__main__':
     tp_overlap_row()
+    sentinel_overhead_row()
     main()

@@ -744,6 +744,166 @@ class TestRecoveryPaths:
             repository.close()
 
 
+class TestFlakySaves:
+    """Satellite: checkpoint durability under a flaky filesystem — bounded
+    retry+backoff on the save, and background async failures surfacing at
+    the NEXT save()/newest() instead of hiding until wait()/fence()."""
+
+    def test_save_retries_transient_fs_errors(self, tmp_path, monkeypatch,
+                                              caplog):
+        loader, state, step = make_parts()
+        with Checkpointer(tmp_path, async_save=False,
+                          retry_backoff=0.01) as checkpointer:
+            manager = checkpointer._manager(IDENTITY)
+            real_save, calls = manager.save, []
+
+            def flaky(*args, **kwargs):
+                calls.append(1)
+                if len(calls) <= 2:
+                    raise OSError('EIO: flaky mount')
+                return real_save(*args, **kwargs)
+
+            monkeypatch.setattr(manager, 'save', flaky)
+            with caplog.at_level(logging.WARNING, 'tpusystem.checkpoint'):
+                checkpointer.save(IDENTITY, 1, state)
+            assert len(calls) == 3
+            assert checkpointer.verify(IDENTITY, 1)
+        assert 'retry 1/2' in caplog.text and 'retry 2/2' in caplog.text
+
+    def test_save_gives_up_after_bounded_retries(self, tmp_path, monkeypatch):
+        loader, state, step = make_parts()
+        with Checkpointer(tmp_path, async_save=False, save_retries=2,
+                          retry_backoff=0.01) as checkpointer:
+            manager = checkpointer._manager(IDENTITY)
+            calls = []
+
+            def dead(*args, **kwargs):
+                calls.append(1)
+                raise OSError('ENOSPC: disk full')
+
+            monkeypatch.setattr(manager, 'save', dead)
+            with pytest.raises(OSError, match='ENOSPC'):
+                checkpointer.save(IDENTITY, 1, state)
+            assert len(calls) == 3               # 1 try + save_retries
+
+    def test_async_failure_surfaces_at_next_save_and_newest(
+            self, tmp_path, monkeypatch):
+        """The fixed gap: a background commit that failed used to stay
+        silent until wait()/fence() — the training loop kept 'saving' into
+        a void. It must raise at the very next save() or newest()."""
+        loader, state, step = make_parts()
+        checkpointer = Checkpointer(tmp_path, async_save=True)
+        try:
+            checkpointer.save(IDENTITY, 1, state)
+            checkpointer.wait()
+            manager = checkpointer._managers[IDENTITY]
+
+            def boom():
+                raise OSError('async commit failed: disk full')
+
+            monkeypatch.setattr(manager, 'check_for_errors', boom,
+                                raising=False)
+            with pytest.raises(OSError, match='async commit failed'):
+                checkpointer.save(IDENTITY, 2, state)
+            with pytest.raises(OSError, match='async commit failed'):
+                checkpointer.newest(IDENTITY)
+        finally:
+            monkeypatch.undo()
+            checkpointer.close()
+
+    def test_legacy_checkpoint_restores_into_grown_train_state(self,
+                                                               tmp_path):
+        """Regression (review finding): TrainState grew the optional
+        ``health`` field — a checkpoint written before it existed must
+        still restore/resume (the leafless field is pruned from the
+        restore target and None grafted back), and only an ARMED target
+        fails loudly."""
+        from tpusystem.train import Guard
+        loader, state, step = make_parts()
+        state, _ = step(state, *next(iter(loader)))
+        legacy = {'params': state.params, 'opt_state': state.opt_state,
+                  'rng': state.rng, 'step': state.step}   # the PR-3 shape
+        with Checkpointer(tmp_path, async_save=False) as checkpointer:
+            checkpointer.save(IDENTITY, 1, legacy,
+                              extras=resume_extras(state, loader))
+            _, blank, _ = make_parts()
+            restored, resumed_step, extras = checkpointer.resume(IDENTITY,
+                                                                 blank)
+            assert resumed_step == 1 and int(restored.step) == 1
+            assert restored.health is None
+            for expected, loaded in zip(jax.tree.leaves(state.params),
+                                        jax.tree.leaves(restored.params)):
+                np.testing.assert_array_equal(np.asarray(expected),
+                                              np.asarray(loaded))
+            # training continues from the grafted state, and arming works
+            armed = Guard().arm(restored)
+            assert armed.health is not None
+            with pytest.raises(Exception):
+                checkpointer.restore(IDENTITY, Guard().arm(blank), epoch=1)
+
+    def test_discard_after_prunes_dead_branch_and_lowers_fence(
+            self, tmp_path):
+        """The rollback epilogue: steps beyond the target vanish (so the
+        retrained steps cannot collide) and a fence pointing into the dead
+        branch is lowered to the target."""
+        loader, state, step = make_parts()
+        with Checkpointer(tmp_path, async_save=False,
+                          max_to_keep=None) as checkpointer:
+            state, _ = drive(loader, state, step, checkpointer, until=6)
+            assert checkpointer.fence(IDENTITY) == 6
+            dead = checkpointer.discard_after(IDENTITY, 3)
+            assert dead == [4, 5, 6]
+            assert checkpointer.epochs(IDENTITY) == [1, 2, 3]
+            assert checkpointer.fenced(IDENTITY) == 3
+            # the retrained branch reuses the freed numbers without clashing
+            checkpointer.save(IDENTITY, 4, state)
+            assert checkpointer.latest(IDENTITY) == 4
+
+
+class TestBarrierTimeout:
+    """Satellite: a peer dead/hung between sync points must surface as a
+    typed CollectiveTimeout instead of hanging the barrier forever."""
+
+    def test_barrier_timeout_raises_typed(self):
+        from tpusystem.parallel.multihost import CollectiveTimeout
+        hub = Hub(2)
+        transports = [TcpTransport(hub.address, rank, 2) for rank in range(2)]
+        assert wait_until(lambda: len(hub._clients) == 2)
+        try:
+            start = time.monotonic()
+            # rank 1 never contributes: it is alive (heartbeats would keep
+            # it in the quota) but stuck between sync points
+            with pytest.raises(CollectiveTimeout, match='timed out'):
+                transports[0].barrier(timeout=1.0)
+            assert time.monotonic() - start < 5
+            assert isinstance(CollectiveTimeout('x'), ControlPlaneFailover)
+            # the late straggler completes the op on the hub; its result
+            # fanout must NOT leak a fresh never-read box into the timed-out
+            # rank's _results (regression: setdefault in the recv loop)
+            transports[1].barrier(timeout=5.0)
+            assert wait_until(lambda: not transports[0]._results)
+        finally:
+            for transport in transports:
+                transport.close()
+            hub.close()
+
+    def test_runtime_barrier_forwards_timeout(self):
+        from tpusystem.parallel.multihost import CollectiveTimeout
+        hub = Hub(2)
+        transports = [TcpTransport(hub.address, rank, 2) for rank in range(2)]
+        assert wait_until(lambda: len(hub._clients) == 2)
+        runtime = Runtime()                      # Loopback: timeout is a no-op
+        runtime.barrier(timeout=0.1)
+        runtime.transport = transports[0]        # the pod-shaped wiring
+        try:
+            with pytest.raises(CollectiveTimeout):
+                runtime.barrier(timeout=1.0)
+        finally:
+            for transport in transports:
+                transport.close()
+            hub.close()
+
+
 # ---------------------------------------------------------------------------
 # cross-process chaos: the real thing, over real processes
 

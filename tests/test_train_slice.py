@@ -235,6 +235,78 @@ def test_gradient_accumulation_token_weighted_under_padding():
                                    rtol=1e-4, atol=1e-6)
 
 
+class MaskedCrossEntropy:
+    """CrossEntropyLoss with pad ids < 0 masked out, exposing the
+    ``weight`` seam (unmasked-example count) the accumulation path keys on
+    — the minimal criterion shape of the masked LM losses."""
+
+    def __call__(self, logits, targets):
+        import optax
+        mask = (targets >= 0).astype(jnp.float32)
+        safe = jnp.maximum(targets, 0)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), safe)
+        return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def weight(self, targets):
+        return jnp.sum((targets >= 0).astype(jnp.float32))
+
+
+def test_gradient_accumulation_fully_padded_microbatch_no_nan():
+    """Satellite: a FULLY padded microbatch contributes weight 0 — its
+    (0-weighted) grads and loss must drop out of the weighted mean without
+    poisoning it, matching the full-batch step on the valid rows."""
+    module = MLP(features=(16,), classes=10, dropout=0.0)
+    optimizer = Adam(lr=1e-2)
+    criterion = MaskedCrossEntropy()
+    apply_fn = flax_apply(module)
+    rng = np.random.default_rng(9)
+    inputs = jnp.asarray(rng.standard_normal((8, 28, 28)), jnp.float32)
+    targets = np.asarray(rng.integers(0, 10, (8,)), np.int32)
+    targets[:2] = -1                 # microbatch 0 of accumulate=4: all pad
+    targets = jnp.asarray(targets)
+
+    full = build_train_step(apply_fn, criterion, optimizer, jit=False)
+    accum = build_train_step(apply_fn, criterion, optimizer, accumulate=4,
+                             jit=False)
+    state_a = init_state(module, optimizer, inputs[:1], rng=0)
+    state_b = init_state(module, optimizer, inputs[:1], rng=0)
+    state_a, (_, loss_a) = full(state_a, inputs, targets)
+    state_b, (_, loss_b) = accum(state_b, inputs, targets)
+    assert np.isfinite(float(loss_b))
+    np.testing.assert_allclose(float(loss_a), float(loss_b), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(state_a.params),
+                    jax.tree.leaves(state_b.params)):
+        assert np.all(np.isfinite(np.asarray(b)))
+        # Adam's rsqrt amplifies the f32-accumulation reorder on tiny
+        # grads (same caveat as the token-weighted sibling test)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=2e-6)
+
+
+def test_gradient_accumulation_all_pad_batch_epsilon_guard():
+    """Satellite (train/step.py weight_sum epsilon): EVERY microbatch fully
+    padded — weight_sum hits the epsilon floor, the step must produce
+    finite zero-ish grads (params bitwise unchanged for SGD-free Adam
+    moments at zero grads is not guaranteed; finiteness and a zero loss
+    are), never NaN."""
+    module = MLP(features=(16,), classes=10, dropout=0.0)
+    optimizer = Adam(lr=1e-2)
+    criterion = MaskedCrossEntropy()
+    step = build_train_step(flax_apply(module), criterion, optimizer,
+                            accumulate=4, jit=False)
+    inputs = jnp.asarray(np.random.default_rng(9).standard_normal((8, 28, 28)),
+                         jnp.float32)
+    targets = jnp.full((8,), -1, jnp.int32)     # nothing valid anywhere
+    state = init_state(module, optimizer, inputs[:1], rng=0)
+    state, (_, loss) = step(state, inputs, targets)
+    assert float(loss) == 0.0
+    for leaf in jax.tree.leaves(state.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+    for leaf in jax.tree.leaves(state.opt_state):
+        assert np.all(np.isfinite(np.asarray(leaf)))
+
+
 @pytest.mark.slow
 def test_gradient_accumulation_bf16_params_compile():
     """Weighted accumulation keeps the scan carry well-typed when params are

@@ -30,10 +30,13 @@ atomically-renamed sidecar, and :meth:`resume` returns both.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import logging
 import os
 import pathlib
+import shutil
+import time
 from typing import Any
 
 import jax
@@ -61,6 +64,60 @@ def abstract_like(tree: Any) -> Any:
     return jax.tree.map(spec, tree)
 
 
+def _has_leaves(node: Any) -> bool:
+    return bool(jax.tree.leaves(node))
+
+
+def _shrink_empty_fields(node: Any) -> Any:
+    """Image of a restore target without its leafless dataclass fields.
+
+    A pytree dataclass that grew an *optional* field (``TrainState.health``,
+    None when unused) no longer structure-matches checkpoints written
+    before the field existed — Orbax compares tree keys, and the empty
+    field still contributes one. This maps dataclass/struct nodes to plain
+    dicts of their leaf-bearing fields (and prunes leafless dict entries),
+    while sequences keep their exact type and arity — an optax chain tuple
+    is saved as a list and must stay positional.
+    """
+    if dataclasses.is_dataclass(node) and not isinstance(node, type):
+        return {field.name: _shrink_empty_fields(getattr(node, field.name))
+                for field in dataclasses.fields(node)
+                if _has_leaves(getattr(node, field.name))}
+    if isinstance(node, dict):
+        return {key: _shrink_empty_fields(value)
+                for key, value in node.items() if _has_leaves(value)}
+    if isinstance(node, (list, tuple)):
+        rebuilt = [_shrink_empty_fields(value) for value in node]
+        if hasattr(node, '_fields'):          # namedtuple (optax states)
+            return type(node)(*rebuilt)
+        return type(node)(rebuilt)
+    return node
+
+
+def _graft_restored(abstract: Any, image: Any) -> Any:
+    """Reassemble ``abstract``'s structure from a shrunken-image restore:
+    restored arrays land in their positions, pruned (leafless) fields keep
+    the target's own value (e.g. ``health=None``)."""
+    if dataclasses.is_dataclass(abstract) and not isinstance(abstract, type):
+        fields = {}
+        for field in dataclasses.fields(abstract):
+            value = getattr(abstract, field.name)
+            fields[field.name] = (_graft_restored(value, image[field.name])
+                                  if _has_leaves(value) else value)
+        return type(abstract)(**fields)
+    if isinstance(abstract, dict):
+        return {key: (_graft_restored(value, image[key])
+                      if _has_leaves(value) else value)
+                for key, value in abstract.items()}
+    if isinstance(abstract, (list, tuple)):
+        rebuilt = [_graft_restored(value, image[index])
+                   for index, value in enumerate(abstract)]
+        if hasattr(abstract, '_fields'):
+            return type(abstract)(*rebuilt)
+        return type(abstract)(rebuilt)
+    return image
+
+
 def _atomic_write(path: pathlib.Path, text: str) -> None:
     """Write-then-rename so readers never see a torn file (the same
     atomicity discipline Orbax applies to whole step dirs)."""
@@ -81,15 +138,21 @@ class Checkpointer:
 
     def __init__(self, root: str | pathlib.Path, *, max_to_keep: int | None = 3,
                  keep_every: int | None = None,
-                 async_save: bool = True) -> None:
+                 async_save: bool = True, save_retries: int = 2,
+                 retry_backoff: float = 0.5) -> None:
         """``max_to_keep`` bounds the rolling window; ``keep_every`` pins
         every Nth step forever in addition (GC policy: a long run keeps
         recent checkpoints for resume plus periodic ones for analysis
-        /rollback instead of losing all history to the window)."""
+        /rollback instead of losing all history to the window).
+        ``save_retries`` bounds the retry loop a flaky filesystem gets
+        before :meth:`save` gives up (exponential backoff starting at
+        ``retry_backoff`` seconds)."""
         self.root = pathlib.Path(root).absolute()
         self.max_to_keep = max_to_keep
         self.keep_every = keep_every
         self.async_save = async_save
+        self.save_retries = save_retries
+        self.retry_backoff = retry_backoff
         self._managers: dict[str, ocp.CheckpointManager] = {}
 
     def _manager(self, identity: str) -> ocp.CheckpointManager:
@@ -118,15 +181,50 @@ class Checkpointer:
         written synchronously to an atomically-renamed sidecar — it never
         blocks on the array serialization — and comes back via
         :meth:`extras` / :meth:`resume`.
+
+        Failure surfacing: a *previous* async save that failed in the
+        background raises here (and at :meth:`newest`) instead of hiding
+        until :meth:`wait`/:meth:`fence` — the training loop learns its
+        durability story broke at the very next step, while the state that
+        could re-save is still alive. The save itself gets a bounded
+        retry with exponential backoff (``save_retries`` / ``retry_backoff``)
+        against transient filesystem errors before giving up.
         """
+        self._surface_async_errors(identity)
         if extras is not None:
             # sidecar BEFORE the array commit: a kill between the two must
             # not leave a committed step with no cursor (an orphan sidecar
             # for a never-committed step is harmless and pruned later)
             _atomic_write(self._extras_path(identity, epoch),
                           json.dumps(extras))
-        self._manager(identity).save(epoch, args=ocp.args.StandardSave(state))
+        manager = self._manager(identity)
+        for attempt in range(self.save_retries + 1):
+            try:
+                manager.save(epoch, args=ocp.args.StandardSave(state))
+                break
+            except OSError as error:
+                if attempt == self.save_retries:
+                    raise
+                delay = self.retry_backoff * (2 ** attempt)
+                logger.warning(
+                    'checkpoint save %s/%s/%d failed (%s); retry %d/%d in '
+                    '%.1fs', self.root, identity, epoch, error, attempt + 1,
+                    self.save_retries, delay)
+                time.sleep(delay)
         self._prune_extras(identity)
+
+    def _surface_async_errors(self, identity: str) -> None:
+        """Re-raise a background async-save failure at the *next* call.
+
+        Orbax parks exceptions from the commit thread until someone asks;
+        without this probe they only surfaced at ``wait``/``fence`` —
+        potentially thousands of steps after the durability story silently
+        broke. Gated on the public ``check_for_errors`` where this Orbax
+        has it."""
+        manager = self._managers.get(identity)
+        check = getattr(manager, 'check_for_errors', None)
+        if check is not None:
+            check()
 
     def _extras_path(self, identity: str, epoch: int) -> pathlib.Path:
         return self.root / identity / _EXTRAS_DIR / f'{int(epoch)}.json'
@@ -268,9 +366,36 @@ class Checkpointer:
                     f'no committed checkpoint for identity {identity!r} at '
                     f'epoch {epoch} under {self.root} '
                     f'(committed epochs: {available or "none"})')
-            return self._manager(identity).restore(
-                epoch, args=ocp.args.StandardRestore(abstract))
+            return self._restore_step(identity, epoch, abstract)
         return self._restore_newest(identity, abstract)[0]
+
+    def _restore_step(self, identity: str, epoch: int, abstract: Any) -> Any:
+        """One step's restore, with the legacy-shape fallback.
+
+        A target pytree that grew optional (leafless) dataclass fields
+        since the checkpoint was written — ``TrainState.health`` is the
+        canonical case — fails Orbax's structure match even though every
+        *array* still lines up. On that specific key-mismatch the restore
+        retries with the leafless fields pruned from the target
+        (:func:`_shrink_empty_fields`) and grafts the arrays back into the
+        caller's structure, so pre-upgrade runs keep resuming. A target
+        whose new fields carry arrays (an armed guard against a pre-guard
+        checkpoint) still fails loudly: restore unarmed, then arm.
+        """
+        manager = self._manager(identity)
+        try:
+            return manager.restore(epoch, args=ocp.args.StandardRestore(abstract))
+        except ValueError as error:
+            if 'key mismatch' not in str(error).lower():
+                raise
+            logger.warning(
+                'restore target for %s/%d has fields the checkpoint '
+                'predates; retrying with the legacy-shape subset (%s)',
+                identity, epoch, str(error)[:200])
+            image = manager.restore(
+                epoch, args=ocp.args.StandardRestore(
+                    _shrink_empty_fields(abstract)))
+            return _graft_restored(abstract, image)
 
     def _restore_newest(self, identity: str, abstract: Any) -> tuple[Any, int]:
         """Restore the newest committed step, falling back over steps whose
@@ -287,8 +412,7 @@ class Checkpointer:
         errors: list[tuple[int, Exception]] = []
         for step in reversed(candidates):
             try:
-                state = self._manager(identity).restore(
-                    step, args=ocp.args.StandardRestore(abstract))
+                state = self._restore_step(identity, step, abstract)
                 return state, step
             except Exception as error:  # torn payload that passed the probe
                 errors.append((step, error))
@@ -333,12 +457,50 @@ class Checkpointer:
         (``Repository.store``'s auto increment), never resume: a torn dir
         still owns its number (saving over it would collide) and an
         in-flight step has nothing readable on disk yet, so no integrity
-        probe runs here."""
+        probe runs here. Like :meth:`save`, re-raises a background
+        async-save failure instead of deferring it to ``wait``/``fence``."""
+        self._surface_async_errors(identity)
         on_disk = self._disk_steps(identity)
         candidates = [step for step in (on_disk[-1] if on_disk else None,
                                         self._manager(identity).latest_step())
                       if step is not None]
         return max(candidates) if candidates else None
+
+    def discard_after(self, identity: str, step: int) -> list[int]:
+        """Drop every step dir newer than ``step`` — the rollback epilogue.
+
+        After a sentinel rollback (:class:`tpusystem.train.Sentinel`), the
+        steps beyond the rollback target are a dead branch: their params
+        carry (or postdate) the anomaly, and leaving them on disk would
+        make the retrained steps collide with their numbers
+        (StepAlreadyExists) and make ``latest``/``resume`` prefer the bad
+        branch after a crash. Waits out in-flight saves first, removes the
+        dead steps (committed or torn) plus their sidecars, and lowers the
+        commit fence to ``step`` if it pointed into the discarded range —
+        the fence's "at least this step survived" promise transfers to the
+        rollback target. Returns the discarded step numbers.
+        """
+        self.wait()
+        dead = [at for at in self._disk_steps(identity) if at > step]
+        manager = self._managers.get(identity)
+        for at in dead:
+            delete = getattr(manager, 'delete', None)
+            try:
+                if delete is not None:
+                    delete(at)
+                else:
+                    shutil.rmtree(self.root / identity / str(at))
+            except (OSError, ValueError):
+                shutil.rmtree(self.root / identity / str(at),
+                              ignore_errors=True)
+            (self._extras_path(identity, at)).unlink(missing_ok=True)
+            logger.warning('discarded dead-branch checkpoint %s/%s/%d '
+                           '(rollback to %d)', self.root, identity, at, step)
+        fenced = self.fenced(identity)
+        if fenced is not None and fenced > step:
+            _atomic_write(self.root / identity / _FENCE_FILE,
+                          json.dumps({'step': int(step)}))
+        return dead
 
     def epochs(self, identity: str) -> list[int]:
         """All retained committed epochs for the identity, ascending."""

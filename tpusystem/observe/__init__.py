@@ -11,7 +11,9 @@ materialized host value — consumers never touch device arrays, so one epoch
 has exactly one device→host sync per phase (``metrics.compute()``).
 """
 
-from tpusystem.observe.events import Iterated, StepTimed, Trained, Validated
+from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
+                                      Iterated, ReplicaDiverged, RolledBack,
+                                      StepTimed, Trained, Validated)
 from tpusystem.observe.ledger import EventLedger, LedgerDivergence
 from tpusystem.observe.logs import logging_consumer
 from tpusystem.observe.profile import StepTimer, annotate, step_span, trace
@@ -23,6 +25,7 @@ from tpusystem.observe.tracking import (
 
 __all__ = [
     'Trained', 'Validated', 'Iterated', 'StepTimed',
+    'AnomalyDetected', 'BackoffApplied', 'RolledBack', 'ReplicaDiverged',
     'logging_consumer', 'SummaryWriter', 'tensorboard_consumer',
     'tracking_consumer', 'checkpoint_consumer', 'experiment',
     'metrics_store', 'models_store',

@@ -46,3 +46,52 @@ class StepTimed:
     @property
     def steps_per_second(self) -> float:
         return self.steps / self.seconds if self.seconds else 0.0
+
+
+# --------------------------------------------------------------------------
+# sentinel events — every rung of the divergence-escalation ladder
+# (tpusystem.train.sentinel) is a domain event, so the hash-chain ledger
+# and TensorBoard witness each transition exactly like any other
+# occurrence. ``model`` is the host-side aggregate or the identity string.
+
+
+@event
+class AnomalyDetected:
+    """A step's update was suppressed in-graph (non-finite loss/grads, or a
+    grad-norm spike past the guard's z-score threshold)."""
+    model: Any
+    step: int
+    kind: str          # 'nonfinite' | 'spike'
+    loss: float
+    gnorm: float
+    zscore: float
+
+
+@event
+class BackoffApplied:
+    """The sentinel changed the update scale (level 0 / scale 1.0 is the
+    recovery back to full rate after a healthy streak)."""
+    model: Any
+    step: int
+    level: int
+    scale: float
+
+
+@event
+class RolledBack:
+    """The sentinel rolled the state back to a committed checkpoint and
+    skipped the offending cursor window (PaLM-style skip-batches)."""
+    model: Any
+    step: int
+    to_step: int
+    window: Any        # {'from': cursor, 'to': cursor} — the skipped range
+
+
+@event
+class ReplicaDiverged:
+    """The cross-replica parity check flagged silently corrupted replicas
+    (SDC) before they reached a checkpoint."""
+    model: Any
+    step: int | None
+    replicas: list
+    leaves: list

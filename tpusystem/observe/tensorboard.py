@@ -26,7 +26,9 @@ import socket
 import struct
 import time
 
-from tpusystem.observe.events import Trained, Validated
+from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
+                                      ReplicaDiverged, RolledBack, Trained,
+                                      Validated)
 from tpusystem.services.prodcon import Consumer, Depends
 
 # ---------------------------------------------------------------- crc32c ---
@@ -159,5 +161,37 @@ def tensorboard_consumer() -> Consumer:
         for name, value in event.metrics.items():
             board.add_scalar(f'{event.model.id}/{name}/{phase}', value,
                              getattr(event.model, 'epoch', 0))
+
+    def _subject(model) -> str:
+        return str(getattr(model, 'id', model))
+
+    # sentinel ladder: each transition charted at its global step, so a
+    # loss-spike investigation reads straight off the run's dashboard
+
+    @consumer.handler
+    def on_anomaly(event: AnomalyDetected,
+                   board: SummaryWriter = Depends(writer)) -> None:
+        tag = f'{_subject(event.model)}/sentinel'
+        board.add_scalar(f'{tag}/anomaly', 1.0, event.step)
+        if event.kind == 'spike':     # non-finite values break TB charts
+            board.add_scalar(f'{tag}/spike_zscore', event.zscore, event.step)
+
+    @consumer.handler
+    def on_backoff(event: BackoffApplied,
+                   board: SummaryWriter = Depends(writer)) -> None:
+        board.add_scalar(f'{_subject(event.model)}/sentinel/lr_scale',
+                         event.scale, event.step)
+
+    @consumer.handler
+    def on_rollback(event: RolledBack,
+                    board: SummaryWriter = Depends(writer)) -> None:
+        board.add_scalar(f'{_subject(event.model)}/sentinel/rollback_to',
+                         float(event.to_step), event.step)
+
+    @consumer.handler
+    def on_replica_diverged(event: ReplicaDiverged,
+                            board: SummaryWriter = Depends(writer)) -> None:
+        board.add_scalar(f'{_subject(event.model)}/sentinel/sdc_replicas',
+                         float(len(event.replicas)), event.step or 0)
 
     return consumer

@@ -5,13 +5,14 @@ from tpusystem.parallel.mesh import (
     single_device_mesh,
 )
 from tpusystem.parallel.multihost import (
-    ControlPlaneFailover, DistributedProducer, DistributedPublisher, Hub,
-    Loopback, TcpTransport, World, WorkerJoined, WorkerLost, agree, connect,
-    world,
+    CollectiveTimeout, ControlPlaneFailover, DistributedProducer,
+    DistributedPublisher, Hub, Loopback, TcpTransport, World, WorkerJoined,
+    WorkerLost, agree, connect, world,
 )
 from tpusystem.parallel.collectives import (
     all_gather, all_reduce_mean, all_reduce_sum, all_to_all, axis_index,
-    axis_size, reduce_scatter, ring_shift, ring_shift_chunked,
+    axis_size, reduce_scatter, replica_checksums, ring_shift,
+    ring_shift_chunked,
 )
 from tpusystem.parallel.overlap import (
     allgather_matmul, allgather_plan, matmul_reducescatter,
@@ -20,10 +21,12 @@ from tpusystem.parallel.overlap import (
 from tpusystem.parallel.pipeline import (PipelineParallel,
                                          compose_stacked_rules,
                                          pipeline_apply, pipeline_train)
-from tpusystem.parallel.chaos import (ChaosHub, ChaosTransport, DieAtStep,
-                                      Faults, WorkerKilled)
-from tpusystem.parallel.recovery import (LOST_WORKER_EXIT, PREEMPTED_EXIT,
-                                         RESTART_EXITS, Preempted,
+from tpusystem.parallel.chaos import (ChaosHub, ChaosTransport, CorruptBatch,
+                                      CorruptGrads, DieAtStep, Faults,
+                                      FlipParamBit, WorkerKilled)
+from tpusystem.parallel.recovery import (DIVERGED_EXIT, LOST_WORKER_EXIT,
+                                         PREEMPTED_EXIT, RESTART_EXITS,
+                                         DivergenceError, Preempted,
                                          WorkerLostError, exit_for_restart,
                                          recovery_consumer)
 from tpusystem.parallel.sharding import (
@@ -38,14 +41,17 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'pipeline_apply', 'pipeline_train',
            'AXES', 'DATA', 'FSDP', 'MODEL', 'SEQ', 'EXPERT', 'STAGE',
            'World', 'world', 'connect', 'agree', 'Hub', 'Loopback',
-           'ControlPlaneFailover',
+           'ControlPlaneFailover', 'CollectiveTimeout',
            'TcpTransport', 'DistributedProducer', 'DistributedPublisher',
            'WorkerLost', 'WorkerJoined',
            'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT',
            'Preempted', 'PREEMPTED_EXIT', 'RESTART_EXITS', 'exit_for_restart',
+           'DivergenceError', 'DIVERGED_EXIT',
            'Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
+           'CorruptGrads', 'CorruptBatch', 'FlipParamBit',
            'all_reduce_sum', 'all_reduce_mean', 'all_gather',
            'reduce_scatter', 'all_to_all', 'ring_shift',
            'ring_shift_chunked', 'axis_index', 'axis_size',
+           'replica_checksums',
            'allgather_matmul', 'matmul_reducescatter',
            'allgather_plan', 'reducescatter_plan', 'tp_ffn', 'tp_swiglu']

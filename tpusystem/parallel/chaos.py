@@ -38,7 +38,8 @@ from typing import Any, Callable
 
 from tpusystem.parallel.multihost import Hub, TcpTransport
 
-__all__ = ['Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled']
+__all__ = ['Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
+           'CorruptGrads', 'CorruptBatch', 'FlipParamBit']
 
 
 @dataclass
@@ -215,3 +216,146 @@ class DieAtStep:
             os._exit(self.code)
         else:
             raise WorkerKilled(self.step)
+
+
+# ---------------------------------------------------------------------------
+# internal faults: the divergence-sentinel drill kinds
+#
+# DieAtStep simulates the process failing as a unit; these simulate the
+# *numerics* failing while the process lives — the faults the in-graph
+# guard + Sentinel ladder (tpusystem.train.sentinel) must absorb. Same
+# discipline as the frame faults above: deterministic (step-indexed, not
+# sampled), so every drill is replayable.
+
+
+@dataclass
+class CorruptGrads:
+    """In-graph gradient corruption over a step window (traced).
+
+    Pass as ``build_train_step(..., fault=CorruptGrads(step=k))``: the
+    corruption is compiled into the jitted step and fires when the step
+    being computed (1-based, ``state.step + 1``) falls in
+    ``[step, step + steps)`` — the device-side analogue of a bad batch or a
+    flaky matmul unit. Because it is keyed on the *step counter*, the fault
+    refires if a rollback rewinds the counter into the window — useful for
+    drilling the bounded give-up; use :class:`CorruptBatch` for data-borne
+    corruption that a skip-window genuinely escapes.
+
+    Modes: ``'nan'`` / ``'inf'`` poison every gradient leaf (the finiteness
+    check must suppress the update); ``'spike'`` scales the gradients by
+    ``scale`` — finite, so only the EMA z-score detector catches it.
+    """
+
+    step: int
+    steps: int = 1
+    mode: str = 'nan'     # 'nan' | 'inf' | 'spike'
+    scale: float = 1e4
+
+    def __call__(self, current_step, grads, loss):
+        import jax
+        import jax.numpy as jnp
+        fire = ((current_step >= self.step)
+                & (current_step < self.step + self.steps))
+        if self.mode == 'spike':
+            corrupt = lambda leaf: leaf * jnp.asarray(self.scale, leaf.dtype)
+        elif self.mode in ('nan', 'inf'):
+            bad = float('nan') if self.mode == 'nan' else float('inf')
+            corrupt = lambda leaf: jnp.full_like(leaf, bad)
+        else:
+            raise ValueError(f"mode must be 'nan', 'inf' or 'spike', "
+                             f'got {self.mode!r}')
+        grads = jax.tree.map(
+            lambda leaf: jnp.where(fire, corrupt(leaf), leaf), grads)
+        return grads, loss
+
+
+@dataclass
+class CorruptBatch:
+    """Host-side data corruption of a window of the batch *stream*.
+
+    The data-borne sibling of :class:`CorruptGrads`: poison the
+    ``batch``-th through ``batch + steps - 1``-th batches **fed through
+    this injector** (1-based count of calls; float leaves become ``value``,
+    integer leaves are left alone), producing non-finite loss/grads the
+    guard must suppress. The window is keyed on the data stream — NOT the
+    step counter — because that is what real data-borne corruption does:
+    after a sentinel rollback rewinds the step counter and skips the
+    offending cursor range, the poisoned batches are never consumed again,
+    so the fault does not refire. (Contrast :class:`CorruptGrads`, whose
+    counter-keyed window deliberately refires across a rollback.)::
+
+        for inputs, targets in loader:
+            inputs = corrupt(inputs)
+            state, (_, loss) = step(state, inputs, targets)
+    """
+
+    batch: int
+    steps: int = 1
+    value: float = float('nan')
+    fed: int = field(default=0, init=False)
+
+    def __call__(self, batch_tree: Any) -> Any:
+        import jax
+        import jax.numpy as jnp
+        self.fed += 1
+        if not (self.batch <= self.fed < self.batch + self.steps):
+            return batch_tree
+        return jax.tree.map(
+            lambda leaf: (jnp.full_like(leaf, self.value)
+                          if jnp.issubdtype(jnp.asarray(leaf).dtype,
+                                            jnp.floating) else leaf),
+            batch_tree)
+
+
+@dataclass
+class FlipParamBit:
+    """Silent data corruption: flip one bit of one param leaf on ONE
+    replica of a mesh axis — the cosmic-ray/bad-HBM signature the
+    cross-replica parity check
+    (:meth:`tpusystem.train.Sentinel.check_parity`) must catch before the
+    next checkpoint commits.
+
+    ``__call__(params, mesh)`` returns a copy of the pytree where exactly
+    ONE device — coordinate ``replica`` on ``axis``, coordinate 0 on every
+    other mesh axis — holds the flipped value of leaf ``leaf`` (index into
+    ``jax.tree.leaves`` order) while every other device keeps the
+    original: the replicas now silently disagree, exactly what a real SDC
+    leaves behind. One device, one element, one bit — a cosmic ray does
+    not coordinate across shards (and a multi-device flip could even
+    cancel in an additive checksum: ``+2^b`` on one shard against ``-2^b``
+    on another). The flip lands on the element at flat ``index`` of the
+    victim device's *local shard*, bit ``bit`` (LSB-first within the
+    element's bytes).
+    """
+
+    replica: int = 0
+    leaf: int = 0
+    index: int = 0
+    bit: int = 0
+    axis: str = 'data'
+
+    def __call__(self, params: Any, mesh) -> Any:
+        import jax
+        import numpy as np
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        target = leaves[self.leaf]
+        victim = mesh.devices[tuple(
+            self.replica if name == self.axis else 0
+            for name in mesh.axis_names)]
+        pieces = []
+        for shard in target.addressable_shards:
+            if shard.device != victim:
+                pieces.append(shard.data)
+                continue
+            host = np.asarray(shard.data)
+            raw = bytearray(host.tobytes())
+            offset = self.index * host.dtype.itemsize + self.bit // 8
+            raw[offset] ^= 1 << (self.bit % 8)
+            flipped = np.frombuffer(bytes(raw),
+                                    dtype=host.dtype).reshape(host.shape)
+            pieces.append(jax.device_put(flipped, shard.device))
+        corrupted = jax.make_array_from_single_device_arrays(
+            target.shape, target.sharding, pieces)
+        leaves = list(leaves)
+        leaves[self.leaf] = corrupted
+        return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -10,11 +10,16 @@ vocabulary with ring-neighbor conventions fixed in a single place.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
 
 from tpusystem.parallel.mesh import axis_size as _axis_size
+from tpusystem.parallel.mesh import shard_map as _shard_map
 
 
 def all_reduce_sum(value, axis: str):
@@ -88,3 +93,71 @@ def axis_index(axis: str):
 
 def axis_size(axis: str):
     return _axis_size(axis)
+
+
+# ---------------------------------------------------------------------------
+# cross-replica parity (SDC detection)
+
+
+def _bit_checksum(leaf):
+    """Order-independent uint32 checksum of a leaf's raw bits.
+
+    ``bitcast -> widen -> wrapping sum``: integer addition is commutative,
+    so the checksum is layout- and reduction-order-independent — two
+    replicas holding bit-identical data always agree, and any single bit
+    flip changes the sum (multi-flip collisions are the usual mod-2^32
+    checksum caveat). Float summation would not give that guarantee.
+    """
+    nbits = np.dtype(leaf.dtype).itemsize * 8
+    if nbits > 32:   # 64-bit leaves split into two uint32 words
+        bits = lax.bitcast_convert_type(leaf, jnp.uint32)
+    else:
+        bits = lax.bitcast_convert_type(
+            leaf, jnp.dtype(f'uint{nbits}')).astype(jnp.uint32)
+    return jnp.sum(bits, dtype=jnp.uint32)
+
+
+@functools.lru_cache(maxsize=32)
+def _checksum_program(mesh, specs, axis: str):
+    """Compiled per-(mesh, layout) checksum gather — jit caches per shape."""
+    others = tuple(name for name in mesh.axis_names if name != axis)
+
+    def local(*shards):
+        vec = jnp.stack([_bit_checksum(shard) for shard in shards])
+        if others:
+            # fold shard checksums into the replica's full-leaf checksum
+            vec = lax.psum(vec, others)
+        return lax.all_gather(vec, axis)
+
+    mapped = _shard_map(local, mesh=mesh, in_specs=specs,
+                        out_specs=PartitionSpec(), check_vma=False)
+    return jax.jit(mapped)
+
+
+def replica_checksums(tree, mesh, *, axis: str = 'data'):
+    """Per-replica bit checksums of every leaf in ``tree``.
+
+    The device half of the sentinel's SDC parity check
+    (:meth:`tpusystem.train.Sentinel.check_parity`): each device checksums
+    its local shard of every leaf, the checksums are summed over the
+    non-``axis`` mesh axes (one scalar per leaf per replica) and
+    all-gathered over ``axis`` — exchanged bytes are
+    ``4 * leaves * axis_size``, independent of the model size, so the check
+    is cheap enough for checkpoint cadence.
+
+    Returns ``(matrix, paths)``: a ``[axis_size, leaves]`` uint32 numpy
+    matrix (row ``r`` = replica ``r``'s per-leaf checksums; for params
+    replicated over ``axis`` all rows must be equal) and the matching leaf
+    path strings. The host read is one scalar matrix — the same cadence
+    discipline as the health vector.
+    """
+    leaves = jax.tree.leaves(tree)
+    paths = [jax.tree_util.keystr(path) for path, _ in
+             jax.tree_util.tree_flatten_with_path(tree)[0]]
+    specs = tuple(
+        leaf.sharding.spec
+        if isinstance(getattr(leaf, 'sharding', None), NamedSharding)
+        else PartitionSpec()
+        for leaf in leaves)
+    program = _checksum_program(mesh, specs, axis)
+    return np.asarray(jax.device_get(program(*leaves))), paths

@@ -154,6 +154,17 @@ class ControlPlaneFailover(RuntimeError):
     restore). Collectives issued *after* the failover complete normally
     on the promoted deputy."""
 
+
+class CollectiveTimeout(ControlPlaneFailover):
+    """A host collective did not complete within its timeout.
+
+    The usual cause is a peer that died or hung *between* sync points —
+    alive enough that the hub has not excluded it, but never contributing
+    its share — so the waiter would otherwise block forever. Raised typed
+    (instead of the raw ``queue.Empty`` it used to surface as) so callers
+    can checkpoint-fence and restart like any other control-plane loss.
+    """
+
 _REDUCERS: dict[str, Callable[[list], Any]] = {
     'and': all,
     'or': any,
@@ -505,7 +516,7 @@ class Loopback:
     def gather(self, value: Any) -> list:
         return [value]
 
-    def barrier(self) -> None:
+    def barrier(self, timeout: float = 300.0) -> None:
         pass
 
     def heartbeat(self) -> None:
@@ -639,9 +650,14 @@ class TcpTransport:
                     callback(frame[2])
             elif kind == 'result':
                 _, op_key, result = frame
+                # deliver only to a registered box (always present for own
+                # ops — registered before send); a result landing after the
+                # waiter timed out (CollectiveTimeout popped its box) must
+                # not leak a fresh never-read queue into _results
                 with self._results_lock:
-                    box = self._results.setdefault(op_key, queue.Queue())
-                box.put(result)
+                    box = self._results.get(op_key)
+                if box is not None:
+                    box.put(result)
             elif kind == 'rejected':
                 # the hub excluded this rank from the quota; fail the
                 # waiting call fast instead of letting it hit its timeout.
@@ -726,7 +742,14 @@ class TcpTransport:
             self._pending_sends[op_key] = frame
         try:
             self._send(frame, op_key=op_key)
-            result = box.get(timeout=timeout)
+            try:
+                result = box.get(timeout=timeout)
+            except queue.Empty:
+                raise CollectiveTimeout(
+                    f'rank {self.rank}: {kind} collective timed out after '
+                    f'{timeout:.0f}s — a peer likely died or stalled between '
+                    f'sync points; checkpoint-fence and restart, or '
+                    f'resynchronize at a safe point') from None
         finally:
             # timeouts and send failures must not leak the box or leave a
             # stale frame eligible for a later redial replay

@@ -45,9 +45,14 @@ logger = logging.getLogger('tpusystem.recovery')
 
 # conventional exit codes a launcher maps to "restart me": 42 is a peer
 # loss (the mesh must re-form), 43 a preemption of THIS host (SIGTERM from
-# the scheduler); both resume from the last committed checkpoint
+# the scheduler); both resume from the last committed checkpoint. 44 is
+# the sentinel's bounded give-up (DivergenceError): deliberately NOT in
+# RESTART_EXITS — a blind relaunch of a deterministic divergence replays
+# it; launchers should halt for triage (or cap automatic retries and
+# adjust hyperparameters between attempts).
 LOST_WORKER_EXIT = 42
 PREEMPTED_EXIT = 43
+DIVERGED_EXIT = 44
 RESTART_EXITS = frozenset({LOST_WORKER_EXIT, PREEMPTED_EXIT})
 
 
@@ -87,16 +92,39 @@ class Preempted(RuntimeError):
         self.signum = signum
 
 
+class DivergenceError(RuntimeError):
+    """Training diverged beyond the sentinel's escalation ladder.
+
+    Raised by :class:`tpusystem.train.Sentinel` when the bounded give-up is
+    reached (skip → backoff → rollback all failed, or a cross-replica
+    parity check flagged silent data corruption). Maps to
+    :data:`DIVERGED_EXIT` (44) in the launcher contract — unlike 42/43 this
+    is *not* an automatic-restart code: a deterministic divergence replays
+    under a blind relaunch, so the launcher should halt for a human (or an
+    automated sweep) to change something before retrying. An SDC parity
+    failure also lands here: restart from the last committed checkpoint —
+    which passed its parity check — after swapping out the suspect host.
+    """
+
+    def __init__(self, message: str, *, step: int | None = None):
+        super().__init__(message)
+        self.step = step
+
+
 def exit_for_restart(reason: BaseException) -> SystemExit:
-    """Map a recovery exception to its restartable ``SystemExit``.
+    """Map a recovery exception to its contract ``SystemExit``.
 
     ``raise exit_for_restart(error)`` ends the process with the exit code
-    the launcher contract recognizes (:data:`RESTART_EXITS`): the
-    scheduler relaunches the job and the resume path picks up from the
-    last committed checkpoint.
+    the launcher contract recognizes: :data:`RESTART_EXITS` (42 worker
+    lost / 43 preempted) relaunch the job and resume from the last
+    committed checkpoint; :data:`DIVERGED_EXIT` (44, from
+    :class:`DivergenceError`) halts for triage.
     """
-    code = PREEMPTED_EXIT if isinstance(reason, Preempted) else LOST_WORKER_EXIT
-    return SystemExit(code)
+    if isinstance(reason, Preempted):
+        return SystemExit(PREEMPTED_EXIT)
+    if isinstance(reason, DivergenceError):
+        return SystemExit(DIVERGED_EXIT)
+    return SystemExit(LOST_WORKER_EXIT)
 
 
 def recovery_consumer(policy: str = 'abort') -> Consumer:

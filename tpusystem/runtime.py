@@ -225,9 +225,21 @@ class Runtime:
         ``torchsystem/domain/events.py:162-163``)."""
         return multihost.agree(self.transport, wants_stop, op='or')
 
-    def barrier(self) -> None:
-        """Host-level rendezvous (checkpoint commit points etc.)."""
-        self.transport.barrier()
+    def barrier(self, timeout: float | None = None) -> None:
+        """Host-level rendezvous (checkpoint commit points etc.).
+
+        ``timeout`` (seconds, default the transport's 300 s) bounds the
+        wait: a peer that died or hung *between* sync points — past the
+        heartbeat detector but before its next contribution — surfaces as
+        :class:`~tpusystem.parallel.multihost.CollectiveTimeout` (a
+        ``ControlPlaneFailover``) instead of hanging this host forever.
+        Handle it like a worker loss: checkpoint-fence and
+        ``exit_for_restart``.
+        """
+        if timeout is None:
+            self.transport.barrier()
+        else:
+            self.transport.barrier(timeout=timeout)
 
     def close(self) -> None:
         try:

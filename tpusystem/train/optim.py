@@ -16,9 +16,40 @@ Each wrapper exposes ``transform()`` returning the underlying
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 import optax
 
 from tpusystem.registry import register
+
+
+def masked_update(transform, grads, opt_state, params, ok, *, scale=None):
+    """One optimizer update, suppressed in-graph when ``ok`` is False.
+
+    The ``optax.apply_if_finite`` idea generalized to an arbitrary traced
+    health verdict (finiteness AND the guard's spike z-score): the update
+    and the new slot variables are computed unconditionally — one fused
+    program, no host sync, no control flow — and a per-leaf ``where``
+    selects between the advanced and the untouched (params, opt_state).
+    A NaN/Inf gradient therefore never reaches the weights *or* the
+    optimizer moments, which is what makes a skipped batch free to retry
+    or discard (PaLM-style) instead of poisoning every step after it.
+
+    ``scale`` (float32 scalar, typically ``HealthStats.lr_scale``)
+    multiplies the updates before application — for optax's SGD/Adam/AdamW
+    (where weight decay is folded into the update at the learning rate)
+    scaling the update is exactly scaling the learning rate, so a host-side
+    backoff needs no recompilation.
+
+    Returns ``(params, opt_state)``.
+    """
+    updates, new_opt_state = transform.update(grads, opt_state, params)
+    if scale is not None:
+        updates = jax.tree.map(lambda u: u * scale.astype(u.dtype), updates)
+    new_params = optax.apply_updates(params, updates)
+    keep = lambda new, old: jnp.where(ok, new, old)
+    return (jax.tree.map(keep, new_params, params),
+            jax.tree.map(keep, new_opt_state, opt_state))
 
 
 class Optimizer:

@@ -20,7 +20,51 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-__all__ = ['TrainState', 'resume_extras']
+__all__ = ['TrainState', 'HealthStats', 'resume_extras']
+
+
+class HealthStats(struct.PyTreeNode):
+    """Device-side training-health statistics (the ``guard=`` companion).
+
+    Rides :attr:`TrainState.health` as ordinary pytree leaves, so the stats
+    checkpoint, donate, and shard with the rest of the state for free — the
+    guarded step (:func:`tpusystem.train.build_train_step` with ``guard=``)
+    updates them in the same fused XLA program as the optimizer, with no
+    extra host sync.
+
+    Attributes:
+        ema_norm: biased EMA of the global gradient norm (healthy steps only
+            — an anomaly must not poison the statistic that detects it).
+        ema_sq: biased EMA of the squared gradient norm (variance source for
+            the spike z-score).
+        count: number of healthy steps folded into the EMAs (bias correction
+            and the spike detector's warmup gate).
+        bad_steps: cumulative count of steps whose update was suppressed.
+        lr_scale: multiplier applied to the optimizer's updates — the
+            host-side backoff lever (:class:`tpusystem.train.Sentinel`
+            halves it without recompiling; for optax's AdamW/SGD scaling the
+            update is exactly scaling the learning rate).
+        last: the most recent step's health row
+            ``[ok, loss, grad_norm, zscore]`` (float32[4], columns
+            :data:`tpusystem.train.sentinel.HEALTH_COLUMNS`) — what the
+            host-side Sentinel reads at phase cadence.
+    """
+
+    ema_norm: jax.Array
+    ema_sq: jax.Array
+    count: jax.Array
+    bad_steps: jax.Array
+    lr_scale: jax.Array
+    last: jax.Array
+
+    @classmethod
+    def create(cls) -> 'HealthStats':
+        return cls(ema_norm=jnp.zeros((), jnp.float32),
+                   ema_sq=jnp.zeros((), jnp.float32),
+                   count=jnp.zeros((), jnp.int32),
+                   bad_steps=jnp.zeros((), jnp.int32),
+                   lr_scale=jnp.ones((), jnp.float32),
+                   last=jnp.asarray([1.0, 0.0, 0.0, 0.0], jnp.float32))
 
 
 class TrainState(struct.PyTreeNode):
@@ -32,19 +76,29 @@ class TrainState(struct.PyTreeNode):
         rng: PRNG key folded each step for dropout and other stochastic ops.
         step: scalar int32 step counter, lives on device so incrementing it
             never forces a host sync.
+        health: :class:`HealthStats` when the state is armed for a guarded
+            step (``Guard.arm(state)``), else None (an empty pytree
+            subtree — unguarded jitted steps see the same donated tree as
+            before). Checkpoints written before this field existed restore
+            through the Checkpointer's legacy-shape fallback (the leafless
+            field is pruned from the restore target and ``None`` grafted
+            back); restoring such a checkpoint into an *armed* target
+            fails loudly — restore unarmed, then ``arm``.
     """
 
     params: Any
     opt_state: Any
     rng: jax.Array
     step: jax.Array
+    health: Any = None
 
     @classmethod
-    def create(cls, params: Any, opt_state: Any, rng: jax.Array | int = 0) -> 'TrainState':
+    def create(cls, params: Any, opt_state: Any, rng: jax.Array | int = 0,
+               health: Any = None) -> 'TrainState':
         if isinstance(rng, int):
             rng = jax.random.PRNGKey(rng)
         return cls(params=params, opt_state=opt_state, rng=rng,
-                   step=jnp.zeros((), dtype=jnp.int32))
+                   step=jnp.zeros((), dtype=jnp.int32), health=health)
 
     def next_rng(self) -> tuple['TrainState', jax.Array]:
         """Split the carried key; returns (state-with-new-key, subkey)."""

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from tpusystem.train.optim import masked_update
 from tpusystem.train.state import TrainState
 
 # apply_fn contract: (params, inputs, rng, train) -> outputs
@@ -55,12 +56,32 @@ def flax_apply(module) -> ApplyFn:
 
 
 def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
-                     *, accumulate: int = 1, jit: bool = True):
+                     *, accumulate: int = 1, jit: bool = True,
+                     guard=None, fault=None):
     """Build ``step(state, inputs, targets) -> (state, (outputs, loss))``.
 
     ``optimizer`` is a :class:`tpusystem.train.optim.Optimizer` or a raw
     ``optax.GradientTransformation``. The returned step donates ``state``:
     callers must treat the passed-in state as consumed.
+
+    ``guard=`` (a :class:`tpusystem.train.sentinel.Guard`) compiles anomaly
+    detection into the same XLA program: loss/global-grad-norm finiteness
+    plus an EMA grad-norm spike z-score, with the optimizer update
+    suppressed in-graph on a bad step
+    (:func:`tpusystem.train.optim.masked_update`) — no extra dispatch, no
+    host sync. The statistics ride ``state.health``
+    (:class:`~tpusystem.train.state.HealthStats`); arm the state with
+    ``guard.arm(state)`` before the first step. The step counter still
+    advances on a suppressed step (the batch was consumed — PaLM-style
+    skip), while the optimizer's own count does not (schedules see only
+    applied updates).
+
+    ``fault=`` is the chaos-drill seam: a traced callable
+    ``(step, grads, loss) -> (grads, loss)`` applied right after the
+    gradient computation (``step`` is the 1-based index of the step being
+    computed). Production code leaves it None; the chaos harness injects
+    :class:`tpusystem.parallel.chaos.CorruptGrads` here to drill the
+    guard's escalation ladder end-to-end.
 
     ``accumulate=N`` splits the leading batch dimension into N sequential
     microbatches inside the step (``lax.scan``), averaging gradients
@@ -136,15 +157,30 @@ def build_train_step(apply_fn: ApplyFn, criterion: Criterion, optimizer,
             grads = jax.tree.map(
                 lambda g, p: (g / weight_sum).astype(p.dtype), grads, params)
             loss = loss_sum / weight_sum
+        current = state.step + 1
+        if fault is not None:
+            grads, loss = fault(current, grads, loss)
+        if guard is not None:
+            assert state.health is not None, (
+                'guard= needs health stats on the TrainState: arm it with '
+                'Guard.arm(state) before the first step')
+            health, ok = guard.judge(state.health, loss, grads)
+            params, opt_state = masked_update(
+                transform, grads, state.opt_state, state.params, ok,
+                scale=health.lr_scale)
+            state = state.replace(params=params, opt_state=opt_state,
+                                  step=current, health=health)
+            return state, (outputs, loss)
         updates, opt_state = transform.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        state = state.replace(params=params, opt_state=opt_state, step=state.step + 1)
+        state = state.replace(params=params, opt_state=opt_state, step=current)
         return state, (outputs, loss)
 
     return jax.jit(step, donate_argnums=0) if jit else step
 
 
-def build_multi_step(step, *, jit: bool = True, outputs_fn=None):
+def build_multi_step(step, *, jit: bool = True, outputs_fn=None,
+                     guard: bool = False):
     """Wrap an (unjitted) train step into N steps per host dispatch.
 
     ``multi(state, inputs, targets) -> (state, losses)`` where inputs and
@@ -167,15 +203,28 @@ def build_multi_step(step, *, jit: bool = True, outputs_fn=None):
     ``outputs_fn`` (e.g. ``lambda o: jnp.argmax(o, -1)`` for classifier
     predictions) to stack a *reduced* output per step instead; the return
     becomes ``(state, (stacked_reduced_outputs, losses))``.
+
+    ``guard=True`` (for a ``step`` built with ``guard=``) additionally
+    stacks each step's health row (``state.health.last``,
+    :data:`tpusystem.train.sentinel.HEALTH_COLUMNS`), so the host-side
+    :class:`~tpusystem.train.sentinel.Sentinel` reviews every step of the
+    dispatch at the same single phase-cadence sync: the return becomes
+    ``(state, (losses, health[N, 4]))`` (health last when ``outputs_fn``
+    is also given).
     """
     def multi(state: TrainState, inputs, targets):
+        if guard:
+            assert state.health is not None, (
+                'guard=True needs a guarded step and an armed state '
+                '(Guard.arm) — see build_train_step(guard=...)')
         def body(state, xs):
             micro_inputs, micro_targets = xs
             state, (outputs, loss) = step(state, micro_inputs, micro_targets)
             loss = jnp.asarray(loss, jnp.float32)
-            if outputs_fn is None:
-                return state, loss
-            return state, (outputs_fn(outputs), loss)
+            ys = (loss,) if outputs_fn is None else (outputs_fn(outputs), loss)
+            if guard:
+                ys = ys + (state.health.last,)
+            return state, ys[0] if len(ys) == 1 else ys
         return jax.lax.scan(body, state, (inputs, targets))
     return jax.jit(multi, donate_argnums=0) if jit else multi
 
