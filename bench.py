@@ -6,7 +6,10 @@ in a subprocess — virtual-mesh smoke on CPU, real numbers on multi-chip
 TPU; see BASELINE.md "tp_overlap protocol"), then the
 ``sentinel_overhead`` row (steps/s with the in-graph divergence guard on
 vs off — the < 2% budget tracked in BENCH_*.json from day one), then the
-headline as the LAST JSON line (the one the driver parses):
+``recovery_seconds`` row (hot in-memory restore vs disk restore wall
+time on the tiny model — the per-recovery saving the Supervisor's
+memstore tier buys), then the headline as the LAST JSON line (the one
+the driver parses):
 ``{"metric": ..., "value": N, "spread": N, "unit": ..., "vs_baseline": N}``.
 
 ``value`` is the **median of TRIALS (>= 3) timed runs** after a shared
@@ -188,6 +191,66 @@ def sentinel_overhead_row() -> None:
                           'note': f'probe failed: {str(error)[:160]}'}))
 
 
+def recovery_seconds_row() -> None:
+    """Print the hot-vs-disk restore cost on the tiny model: wall seconds
+    to materialize a resumable ``TrainState`` from the supervisor's
+    in-memory store (``hot_resume`` via a local ``MemStore``) vs from the
+    newest committed Orbax checkpoint — the per-recovery saving the
+    Supervisor's memstore tier buys (``value`` is the hot time; both
+    medians of TRIALS). Printed BEFORE the MFU headline; never fails the
+    run (probe errors print a null-value row)."""
+    import tempfile
+    try:
+        import jax.numpy as jnp
+
+        from tpusystem.checkpoint import (Checkpointer, MemStore, hot_resume,
+                                          serialize_state)
+        from tpusystem.models import gpt2_tiny
+        from tpusystem.train import (AdamW, NextTokenLoss, build_train_step,
+                                     flax_apply, init_state)
+
+        module = gpt2_tiny()
+        optimizer = AdamW(lr=1e-3)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, (4, 32)), jnp.int32)
+        state = init_state(module, optimizer, tokens[:1])
+        step = build_train_step(flax_apply(module), NextTokenLoss(),
+                                optimizer)
+        state, _ = step(state, tokens, tokens)
+        identity = 'bench-recovery'
+        with tempfile.TemporaryDirectory() as root, \
+                Checkpointer(root, async_save=False) as checkpointer:
+            checkpointer.save(identity, 1, state, extras={'step': 1})
+            store = MemStore()
+            store.put(identity, 1, serialize_state(state),
+                      extras={'step': 1})
+
+            def timed(client):
+                times = []
+                for _ in range(TRIALS):
+                    start = time.perf_counter()
+                    restored, _, _, source = hot_resume(
+                        checkpointer, identity, state, client)
+                    materialize(restored.params)
+                    times.append(time.perf_counter() - start)
+                return source, sorted(times)[len(times) // 2]
+
+            hot_source, hot = timed(store)
+            disk_source, disk = timed(None)
+        assert (hot_source, disk_source) == ('hot', 'disk')
+        print(json.dumps({
+            'metric': 'recovery_seconds',
+            'value': round(hot, 4),
+            'unit': 's (hot restore, tiny model)',
+            'disk_seconds': round(disk, 4),
+            'hot_speedup_vs_disk': round(disk / hot, 2) if hot else None,
+        }))
+    except Exception as error:  # never cost the headline its run
+        print(json.dumps({'metric': 'recovery_seconds', 'value': None,
+                          'unit': 's',
+                          'note': f'probe failed: {str(error)[:160]}'}))
+
+
 def main() -> None:
     from tpusystem.train import (ChunkedNextTokenLoss, build_train_step,
                                  flax_apply, init_state)
@@ -240,4 +303,5 @@ def main() -> None:
 if __name__ == '__main__':
     tp_overlap_row()
     sentinel_overhead_row()
+    recovery_seconds_row()
     main()

@@ -160,6 +160,7 @@ class TestStepGranularResume:
                             producer.drain()
                             time.sleep(0.01)
             assert excinfo.value.rank == 1
+            assert excinfo.value.reason == 'socket'   # EOF, not a stall
             fenced = checkpointer.fence(IDENTITY)   # emergency durability
             assert fenced == 4
             assert exit_for_restart(excinfo.value).code == LOST_WORKER_EXIT
@@ -418,6 +419,9 @@ class TestChaosControlPlane:
             assert wait_until(lambda: (producer.drain(), bool(lost))[1],
                               timeout=5)
             assert lost[0].rank == 2
+            # satellite: a stall is detected by the liveness monitor, and
+            # the event says so — different MTTR profile than socket death
+            assert lost[0].reason == 'heartbeat'
             # the stalled rank is out of the quota: fail-fast, and the
             # survivors' collectives degrade to the live set
             with pytest.raises(RuntimeError, match='excluded'):

@@ -15,8 +15,8 @@ import time
 import pytest
 
 from tpusystem.parallel.multihost import (
-    DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
-    WorkerLost, agree,
+    BlobError, DistributedProducer, DistributedPublisher, Hub, Loopback,
+    TcpTransport, WorkerLost, agree,
 )
 from tpusystem.services.prodcon import Consumer, event
 from tpusystem.services.pubsub import Subscriber
@@ -298,6 +298,7 @@ class TestFailureDetection:
             # silent-host test below guards against)
             assert wait_until(lambda: (producer.drain(), bool(lost))[1])
             assert lost[0].rank == 1
+            assert lost[0].reason == 'socket'    # EOF, not a stall
         finally:
             transports[0].close()
             hub.close()
@@ -320,6 +321,7 @@ class TestFailureDetection:
             assert wait_until(lambda: (producer.drain(), bool(lost))[1],
                               timeout=5)
             assert lost[0].rank == 1
+            assert lost[0].reason == 'heartbeat'   # a stall, not a crash
         finally:
             shutdown(hub, transports)
 
@@ -744,3 +746,193 @@ class TestDeputy:
             for transport in transports:
                 transport.close()
             deputy.close()
+
+
+class TestBlobs:
+    """The blob plane: chunked, digest-verified point-to-point transfers
+    (what the supervisor's hot-state replication rides)."""
+
+    def test_send_blob_is_point_to_point(self):
+        """A blob reaches its addressee intact — reassembled across many
+        bounded chunks — and NOBODY else sees any of it."""
+        hub, transports = pod(3)
+        try:
+            delivered, stray = [], []
+            transports[2].on_blob = lambda s, k, d: delivered.append((s, k, d))
+            transports[1].on_blob = lambda s, k, d: stray.append(k)
+            payload = bytes(range(256)) * 64          # 16 KiB, 16+ chunks
+            transports[0].send_blob(2, 'shard', payload, chunk_size=1024)
+            assert wait_until(lambda: delivered)
+            assert delivered == [(0, 'shard', payload)]
+            time.sleep(0.1)
+            assert stray == []
+        finally:
+            shutdown(hub, transports)
+
+    def test_fetch_blob_request_reply(self):
+        """fetch_blob asks the peer's on_blob_request hook; a peer with
+        nothing NAKs, and the requester gets a typed BlobError fast."""
+        hub, transports = pod(2)
+        try:
+            transports[1].on_blob_request = (
+                lambda key: b'served:' + key.encode() if key == 'have' else None)
+            assert transports[0].fetch_blob(1, 'have',
+                                            timeout=10) == b'served:have'
+            start = time.monotonic()
+            with pytest.raises(BlobError, match='no blob'):
+                transports[0].fetch_blob(1, 'missing', timeout=10)
+            assert time.monotonic() - start < 5       # NAK, not a timeout
+        finally:
+            shutdown(hub, transports)
+
+    def test_unclaimed_push_is_held_for_a_later_fetch(self):
+        hub, transports = pod(2)
+        try:
+            transports[0].send_blob(1, 'early', b'pushed before the fetch')
+            assert wait_until(lambda: 'early' in transports[1]._blob_ready)
+            assert transports[1].fetch_blob(0, 'early',
+                                            timeout=5) == b'pushed before the fetch'
+        finally:
+            shutdown(hub, transports)
+
+    def test_dropped_chunk_times_out_typed(self):
+        """Chaos: lost chunks mean the blob never completes — the fetcher
+        gets a typed BlobError at its own timeout, and the partial
+        assembly is never delivered."""
+        from tpusystem.parallel.chaos import ChaosTransport, Faults
+        faults = Faults(seed=3, drop=0.5, kinds=('blob',))
+        hub = Hub(2)
+        responder = ChaosTransport(hub.address, 0, 2, faults=faults)
+        requester = TcpTransport(hub.address, 1, 2)
+        try:
+            assert wait_until(lambda: len(hub._clients) == 2)
+            received = []
+            requester.on_blob = lambda s, k, d: received.append(k)
+            responder.blob_chunk = 512                           # 16 chunks
+            responder.on_blob_request = lambda key: bytes(8192)
+            start = time.monotonic()
+            with pytest.raises(BlobError, match='did not arrive'):
+                requester.fetch_blob(0, 'torn', timeout=1.0)
+            assert time.monotonic() - start < 5
+            assert faults.dropped                # the fault really fired
+            assert received == []                # no partial delivery
+        finally:
+            responder.close()
+            requester.close()
+            hub.close()
+
+    def test_truncated_chunk_fails_digest_on_fetch(self, caplog):
+        """Chaos: a truncated chunk arrives, the count completes, but the
+        whole-blob digest fails — the waiting fetcher is failed typed and
+        fast instead of receiving torn bytes."""
+        import logging
+        from tpusystem.parallel.chaos import ChaosTransport, Faults
+        faults = Faults(seed=1, truncate=1.0, kinds=('blob',))
+        hub = Hub(2)
+        responder = ChaosTransport(hub.address, 0, 2, faults=faults)
+        requester = TcpTransport(hub.address, 1, 2)
+        try:
+            assert wait_until(lambda: len(hub._clients) == 2)
+            responder.on_blob_request = lambda key: bytes(4096)
+            start = time.monotonic()
+            with caplog.at_level(logging.WARNING, 'tpusystem.multihost'):
+                with pytest.raises(BlobError, match='digest'):
+                    requester.fetch_blob(0, 'torn', timeout=10)
+            assert time.monotonic() - start < 5      # failed fast, typed
+            assert faults.truncated == ['blob']
+            assert 'digest' in caplog.text
+        finally:
+            responder.close()
+            requester.close()
+            hub.close()
+
+    def test_fetch_is_pinned_to_the_requested_peer(self):
+        """Review regression: a same-key blob pushed by a DIFFERENT rank
+        while a fetch is in flight must not be mistaken for the answer —
+        the waiter is pinned to the peer the request went to."""
+        hub, transports = pod(3)
+        try:
+            unsolicited = []
+            transports[0].on_blob = lambda s, k, d: unsolicited.append((s, d))
+            transports[2].on_blob_request = (
+                lambda key: time.sleep(0.5) or b'the real answer')
+            import threading
+            box = {}
+            fetcher = threading.Thread(
+                target=lambda: box.update(
+                    got=transports[0].fetch_blob(2, 'shared-key', timeout=10)))
+            fetcher.start()
+            time.sleep(0.1)                 # fetch registered, reply pending
+            transports[1].send_blob(0, 'shared-key', b'impostor bytes')
+            fetcher.join(timeout=10)
+            assert box['got'] == b'the real answer'
+            assert wait_until(lambda: unsolicited)
+            assert unsolicited == [(1, b'impostor bytes')]
+        finally:
+            shutdown(hub, transports)
+
+    def test_transport_close_fails_inflight_fetch_typed(self):
+        """Review regression: closing the transport with a fetch in
+        flight must fail it typed and fast — the same no-hang-to-timeout
+        discipline the collective waiters get — not leave it to ride out
+        its full timeout."""
+        import threading
+        hub, transports = pod(2)
+        try:
+            transports[1].on_blob_request = (
+                lambda key: time.sleep(30) or b'far too late')
+            outcome = {}
+
+            def fetch():
+                start = time.monotonic()
+                try:
+                    transports[0].fetch_blob(1, 'slow', timeout=60)
+                    outcome['verdict'] = 'completed'
+                except BlobError as error:
+                    outcome['verdict'] = str(error)
+                outcome['elapsed'] = time.monotonic() - start
+
+            fetcher = threading.Thread(target=fetch)
+            fetcher.start()
+            time.sleep(0.2)               # request sent, reply pending
+            transports[0].close()
+            fetcher.join(timeout=10)
+            assert 'closed or failed over' in outcome['verdict']
+            assert outcome['elapsed'] < 5
+        finally:
+            transports[1].close()
+            hub.close()
+
+    def test_concurrent_same_key_fetch_is_refused_typed(self):
+        """Review regression: _blob_waiters holds one registration per
+        key — a second concurrent fetch for the same key is refused typed
+        instead of silently clobbering the first's."""
+        import threading
+        hub, transports = pod(2)
+        try:
+            transports[1].on_blob_request = (
+                lambda key: time.sleep(0.5) or b'answer')
+            box = {}
+            first = threading.Thread(
+                target=lambda: box.update(
+                    got=transports[0].fetch_blob(1, 'dup', timeout=10)))
+            first.start()
+            time.sleep(0.1)
+            with pytest.raises(BlobError, match='already in flight'):
+                transports[0].fetch_blob(1, 'dup', timeout=10)
+            first.join(timeout=10)
+            assert box['got'] == b'answer'     # the first fetch unharmed
+        finally:
+            shutdown(hub, transports)
+
+    def test_loopback_blob_parity(self):
+        transport = Loopback()
+        held = []
+        transport.on_blob = lambda s, k, d: held.append((s, k, d))
+        transport.send_blob(0, 'self', b'stay local')
+        assert held == [(0, 'self', b'stay local')]
+        transport.on_blob_request = (
+            lambda key: b'mine' if key == 'x' else None)
+        assert transport.fetch_blob(0, 'x') == b'mine'
+        with pytest.raises(BlobError):
+            transport.fetch_blob(0, 'absent')

@@ -1,0 +1,593 @@
+"""Hot in-memory checkpoints: state that survives worker death.
+
+Every recovery in PR 3–4 pays a full disk restore — correct, but the disk
+round-trip dominates MTTR once the relaunch itself is seconds
+(:mod:`tpusystem.parallel.supervisor`). Production systems keep *redundant
+in-memory copies of the model state* outside the worker process (Gemini's
+report; MegaScale's driver-side recovery) so a relaunched worker restores
+from local RAM and a replaced host pulls a replica from a peer, with disk
+as the verified fallback. This module is that tier:
+
+* :func:`serialize_state` / :func:`deserialize_state` — a ``TrainState``
+  pytree ⇄ one bytes blob of its host-side leaf arrays. The round trip is
+  **bitwise exact** (``device_get`` → ``device_put`` onto the target's
+  shardings), which is what lets :func:`hot_resume` promise restores
+  identical to the disk path.
+* :class:`MemStore` — the supervisor-side slot table: newest hot state per
+  identity, every read digest-verified (a corrupted slot reads as absent,
+  never as state). ``replica`` slots hold a *buddy host's* cross-replicated
+  copy, served when a replaced host pulls over the control plane.
+* :class:`MemStoreServer` / :class:`MemStoreClient` — the worker ⇄
+  supervisor wire (chunked frames on a local TCP socket, address handed
+  down via the ``TPUSYSTEM_SUPERVISOR`` env var). The client also carries
+  ``mark()`` — the recovery-timeline breadcrumbs (``restore``,
+  ``first-step``) the supervisor stamps into its
+  :class:`~tpusystem.observe.events.RecoveryTimeline`.
+* :func:`hot_resume` — the restart decision: prefer hot state only when
+  its step is **at least** the newest committed disk step and its digest
+  verifies; anything less (stale RAM, torn replica, no supervisor) falls
+  back to :meth:`~tpusystem.checkpoint.Checkpointer.resume`.
+
+The payload is host arrays only — like the control plane, never device
+handles — so a blob is valid across processes and (for replicas) hosts.
+On a multi-host pod each worker ships the shards *it* owns; the buddy pair
+mirrors that host-local blob, so replication cost scales with the local
+shard bytes, not the global model.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import socket
+import threading
+from dataclasses import dataclass
+from typing import Any
+
+from tpusystem.parallel.multihost import (BLOB_CHUNK, _blob_digest,
+                                          _recv_frame, _send_frame)
+
+logger = logging.getLogger('tpusystem.memstore')
+
+__all__ = ['MemStore', 'MemStoreServer', 'MemStoreClient', 'HotState',
+           'serialize_state', 'deserialize_state', 'hot_resume',
+           'supervisor_client', 'SUPERVISOR_ENV']
+
+# how a supervised worker finds its supervisor's memstore endpoint
+SUPERVISOR_ENV = 'TPUSYSTEM_SUPERVISOR'
+
+
+def blob_digest(data: bytes) -> str:
+    """Integrity digest of a hot-state blob (BLAKE2b-128: fast, keyless —
+    this detects corruption, it does not authenticate). The same
+    primitive the transport's blob frames use, on purpose: the slot
+    digest and the transfer digest must never diverge into two
+    incompatible notions of "verified"."""
+    return _blob_digest(data)
+
+
+# ---------------------------------------------------------------------------
+# state <-> bytes
+
+
+def _index_key(index: tuple, shape: tuple) -> tuple:
+    """Canonical hashable form of a shard's global-array slice tuple
+    (``slice.indices`` normalizes the Nones a sharding API may emit)."""
+    return tuple(part.indices(dim) for part, dim in zip(index, shape))
+
+
+class ShardedLeaf:
+    """Host-local shards of a cross-host-sharded array (picklable).
+
+    On a multi-host pod a leaf sharded over hosts is not fully
+    addressable — ``device_get`` on it would raise, and shipping the
+    global array would defeat the point anyway. This carries only the
+    shards *this host* holds, keyed by their global-array slice; the
+    restore side reassembles them onto the target sharding's local
+    devices (same host layout across a restart, the supervisor's case).
+    """
+
+    def __init__(self, shape: tuple, dtype: str, shards: dict) -> None:
+        self.shape = shape
+        self.dtype = dtype
+        self.shards = shards       # {index key: np.ndarray (one per slice)}
+
+    @classmethod
+    def from_array(cls, leaf: Any) -> 'ShardedLeaf':
+        import numpy as np
+        shards: dict = {}
+        for shard in leaf.addressable_shards:
+            key = _index_key(shard.index, leaf.shape)
+            if key not in shards:          # replicas hold identical bytes
+                shards[key] = np.asarray(shard.data)
+        return cls(tuple(leaf.shape), np.dtype(leaf.dtype).str, shards)
+
+    def place(self, leaf: Any) -> Any:
+        """Reassemble onto ``leaf``'s sharding (raises ``ValueError`` when
+        the target layout wants a slice this host never held — e.g. a
+        resize between push and restore; callers fall back to disk)."""
+        import jax
+        import numpy as np
+        if tuple(self.shape) != tuple(leaf.shape) or \
+                np.dtype(self.dtype) != np.dtype(leaf.dtype):
+            raise ValueError(
+                f'hot-state leaf mismatch: blob has {self.shape}/'
+                f'{self.dtype}, target wants {leaf.shape}/{leaf.dtype}')
+        sharding = getattr(leaf, 'sharding', None)
+        if sharding is None:
+            raise ValueError('cannot place host-local shards without a '
+                             'target sharding')
+        index_map = sharding.addressable_devices_indices_map(
+            tuple(self.shape))
+        pieces = []
+        for device, index in index_map.items():
+            data = self.shards.get(_index_key(index, self.shape))
+            if data is None:
+                raise ValueError(
+                    'hot shards do not cover the restore layout (the mesh '
+                    'changed since the push); restore from disk')
+            pieces.append(jax.device_put(data, device))
+        return jax.make_array_from_single_device_arrays(
+            tuple(self.shape), sharding, pieces)
+
+
+def serialize_state(state: Any) -> bytes:
+    """One bytes blob of the pytree's leaf arrays, host-side.
+
+    Fully-addressable leaves travel whole (``device_get`` materializes
+    them exactly — no dtype or layout change), so
+    :func:`deserialize_state` reproduces the state bitwise. A leaf
+    sharded across hosts travels as its host-local shards only
+    (:class:`ShardedLeaf`) — the blob scales with the local bytes, not
+    the global model. Only leaves travel; the treedef is supplied by the
+    restore target, the same contract as Orbax's ``StandardRestore``.
+    """
+    import jax
+    import numpy as np
+    leaves = []
+    for leaf in jax.tree.leaves(state):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            leaves.append(ShardedLeaf.from_array(leaf))
+        else:
+            leaves.append(np.asarray(jax.device_get(leaf)))
+    return pickle.dumps(leaves, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize_state(blob: bytes, target: Any) -> Any:
+    """Rebuild a pytree from :func:`serialize_state` bytes onto ``target``.
+
+    ``target`` is a concrete or abstract pytree (see
+    :func:`tpusystem.checkpoint.abstract_like`): each restored array is
+    placed onto the corresponding leaf's sharding, so a hot restore lands
+    exactly like a disk restore — current mesh, current layout. A
+    structure, shape, or layout mismatch raises ``ValueError`` (the
+    caller falls back to disk); it is never silently coerced.
+    """
+    import jax
+    leaves, treedef = jax.tree.flatten(target)
+    values = pickle.loads(blob)
+    if len(values) != len(leaves):
+        raise ValueError(
+            f'hot state has {len(values)} leaves but the restore target '
+            f'has {len(leaves)} — the run\'s state shape changed since the '
+            f'blob was pushed')
+    placed = []
+    for value, leaf in zip(values, leaves):
+        if isinstance(value, ShardedLeaf):
+            placed.append(value.place(leaf))
+            continue
+        shape = getattr(leaf, 'shape', None)
+        dtype = getattr(leaf, 'dtype', None)
+        if shape is not None and (value.shape != shape
+                                  or value.dtype != dtype):
+            raise ValueError(
+                f'hot-state leaf mismatch: blob has {value.shape}/'
+                f'{value.dtype}, target wants {shape}/{dtype}')
+        sharding = getattr(leaf, 'sharding', None)
+        placed.append(jax.device_put(value, sharding)
+                      if sharding is not None else jax.device_put(value))
+    return jax.tree.unflatten(treedef, placed)
+
+
+# ---------------------------------------------------------------------------
+# the slot table
+
+
+@dataclass
+class HotState:
+    """One identity's newest hot checkpoint."""
+
+    step: int
+    digest: str
+    blob: bytes
+    extras: Any | None = None
+    source: str = 'local'     # 'local' (own worker) | 'replica' (buddy's)
+
+
+def pack_hot(entry: HotState) -> bytes:
+    """Wire form of a slot for cross-host replication (rides
+    ``TcpTransport.send_blob``, which adds its own transfer digest)."""
+    return pickle.dumps((entry.step, entry.digest, entry.extras, entry.blob),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def unpack_hot(data: bytes, source: str = 'replica') -> HotState:
+    step, digest, extras, blob = pickle.loads(data)
+    return HotState(step=int(step), digest=digest, blob=blob, extras=extras,
+                    source=source)
+
+
+class MemStore:
+    """Newest hot state per identity, digest-verified on every read.
+
+    Two namespaces: the ``local`` slots hold what this host's own worker
+    pushed; the ``replica`` slots hold a buddy host's cross-replicated
+    copies, served when that host is replaced and its fresh supervisor
+    pulls over the control plane. A slot whose bytes no longer match
+    their digest — an SDC in RAM, a torn replication — reads as *absent*
+    (logged), so corruption can only ever cost the hot tier, never
+    deliver bad state.
+
+    Also a valid in-process ``client`` for :func:`hot_resume` (it has the
+    same ``fetch`` surface as :class:`MemStoreClient`), which is how the
+    single-process drills and ``bench.py``'s ``recovery_seconds`` probe
+    use it.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._slots: dict[tuple[str, bool], HotState] = {}
+
+    def put(self, identity: str, step: int, blob: bytes, *,
+            extras: Any | None = None, digest: str | None = None,
+            replica: bool = False) -> HotState:
+        """Install a slot (monotonic: an older step never replaces a newer
+        one). A caller-supplied ``digest`` is verified before the bytes
+        are accepted — a transfer torn upstream is rejected here too."""
+        actual = blob_digest(blob)
+        if digest is not None and digest != actual:
+            raise ValueError(
+                f'hot state for {identity!r} step {step} failed its digest '
+                f'check on arrival; rejected')
+        entry = HotState(step=int(step), digest=actual, blob=bytes(blob),
+                         extras=extras,
+                         source='replica' if replica else 'local')
+        with self._lock:
+            held = self._slots.get((identity, replica))
+            if held is not None and held.step > entry.step:
+                return held
+            self._slots[(identity, replica)] = entry
+        return entry
+
+    def newest(self, identity: str, *, replica: bool = False) -> HotState | None:
+        """The identity's slot, or None — also when the held bytes fail
+        their digest (the slot is dropped and logged: corrupt hot state
+        must read as absent, never restore)."""
+        with self._lock:
+            entry = self._slots.get((identity, replica))
+        if entry is None:
+            return None
+        if blob_digest(entry.blob) != entry.digest:
+            logger.warning(
+                'hot state for %r step %d failed its digest check in the '
+                'store; dropping the slot (disk is the fallback)',
+                identity, entry.step)
+            with self._lock:
+                if self._slots.get((identity, replica)) is entry:
+                    del self._slots[(identity, replica)]
+            return None
+        return entry
+
+    # the MemStoreClient-compatible read surface (in-process client)
+    def fetch(self, identity: str) -> HotState | None:
+        return self.newest(identity)
+
+    def drop(self, identity: str, *, replica: bool = False) -> None:
+        with self._lock:
+            self._slots.pop((identity, replica), None)
+
+    def identities(self) -> list[str]:
+        with self._lock:
+            return sorted({name for name, _ in self._slots})
+
+
+# ---------------------------------------------------------------------------
+# worker <-> supervisor wire
+#
+# Frames (length-prefixed pickles, the control plane's framing) on a local
+# TCP socket; only the worker initiates, so replies cannot interleave:
+#   ('put', identity, step, digest, extras, total) + total x ('chunk', i, b)
+#       -> ('ok', step) | ('bad', message)
+#   ('get', identity)
+#       -> ('hot', step, digest, extras, total) + chunks | ('none',)
+#   ('mark', stage, info)            fire-and-forget timeline breadcrumb
+
+
+class MemStoreServer:
+    """The supervisor's memstore endpoint (one thread per connection).
+
+    Hooks: ``on_put(identity, entry)`` fires after a verified local push
+    (the supervisor's replication rider); ``on_mark(stage, info)`` carries
+    the worker's timeline breadcrumbs; ``fetch_fallback(identity)`` is
+    consulted when a ``get`` misses locally (the supervisor's
+    pull-from-buddy path).
+    """
+
+    def __init__(self, store: MemStore | None = None,
+                 host: str = '127.0.0.1', port: int = 0,
+                 on_put: Any = None, on_mark: Any = None,
+                 fetch_fallback: Any = None,
+                 chunk_size: int = BLOB_CHUNK) -> None:
+        self.store = store if store is not None else MemStore()
+        self.on_put = on_put
+        self.on_mark = on_mark
+        self.fetch_fallback = fetch_fallback
+        self.chunk_size = chunk_size
+        self._server = socket.create_server((host, port))
+        self.address = self._server.getsockname()
+        self._closed = threading.Event()
+        self._conns: list[socket.socket] = []
+        self._conns_lock = threading.Lock()
+        self._accept = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept.start()
+
+    @property
+    def env(self) -> dict[str, str]:
+        """The environment entry a spawned worker needs to find us."""
+        return {SUPERVISOR_ENV: f'{self.address[0]}:{self.address[1]}'}
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                return
+            with self._conns_lock:
+                self._conns.append(sock)
+            threading.Thread(target=self._serve, args=(sock,),
+                             daemon=True).start()
+
+    def _serve(self, sock: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                frame = _recv_frame(sock)
+                if frame is None:
+                    return
+                kind = frame[0]
+                if kind == 'put':
+                    self._handle_put(sock, frame)
+                elif kind == 'get':
+                    self._handle_get(sock, frame[1])
+                elif kind == 'mark':
+                    if self.on_mark is not None:
+                        self.on_mark(frame[1], frame[2])
+        except OSError:
+            pass
+        finally:
+            sock.close()
+
+    def _handle_put(self, sock: socket.socket, frame: tuple) -> None:
+        _, identity, step, digest, extras, total = frame
+        parts: list[bytes] = []
+        for _ in range(total):
+            chunk = _recv_frame(sock)
+            if chunk is None or chunk[0] != 'chunk':
+                raise OSError('put stream ended mid-transfer')
+            parts.append(chunk[2])
+        blob = b''.join(parts)
+        try:
+            entry = self.store.put(identity, step, blob, extras=extras,
+                                   digest=digest)
+        except ValueError as error:
+            logger.warning('rejected hot push for %r step %d: %s',
+                           identity, step, error)
+            _send_frame(sock, ('bad', str(error)))
+            return
+        _send_frame(sock, ('ok', entry.step))
+        if self.on_put is not None and entry.step == int(step):
+            self.on_put(identity, entry)
+
+    def _handle_get(self, sock: socket.socket, identity: str) -> None:
+        entry = self.store.newest(identity)
+        if entry is None and self.fetch_fallback is not None:
+            try:
+                entry = self.fetch_fallback(identity)
+            except Exception as error:
+                logger.warning('hot-state fallback fetch for %r failed: %s',
+                               identity, error)
+                entry = None
+        if entry is None:
+            _send_frame(sock, ('none',))
+            return
+        _send_frame(sock, ('hot', entry.step, entry.digest, entry.extras,
+                           max(1, -(-len(entry.blob) // self.chunk_size))))
+        for index in range(0, len(entry.blob) or 1, self.chunk_size):
+            _send_frame(sock, ('chunk', index // self.chunk_size,
+                               entry.blob[index:index + self.chunk_size]))
+
+    def close(self) -> None:
+        self._closed.set()
+        self._server.close()
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for sock in conns:
+            # shutdown before close (the Hub teardown discipline): a serve
+            # thread blocked in recv on the same fd would otherwise hold
+            # the connection open, and clients of a dead supervisor must
+            # see the death immediately, not at their next recv
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            sock.close()
+
+
+class MemStoreClient:
+    """The worker's handle on its supervisor's memstore.
+
+    Every method degrades instead of raising on a dead or wedged
+    supervisor socket: hot state is an accelerator, never a requirement,
+    and a hot-tier-only failure must not take down training that disk
+    checkpoints would have carried (``push`` returns False, ``fetch``
+    returns None — both logged once)."""
+
+    def __init__(self, address: tuple[str, int],
+                 chunk_size: int = BLOB_CHUNK) -> None:
+        self._sock = socket.create_connection(tuple(address), timeout=10.0)
+        self._sock.settimeout(None)
+        self._lock = threading.Lock()
+        self._down = False
+        self.chunk_size = chunk_size
+
+    def _lost(self, what: str, error: Any) -> None:
+        if not self._down:      # log the first failure, not every step
+            logger.warning('supervisor unreachable during %s (%s); hot '
+                           'state disabled — disk checkpoints still stand',
+                           what, error)
+        self._down = True
+
+    def push(self, identity: str, step: int, state: Any, *,
+             extras: Any | None = None) -> bool:
+        """Ship the state's hot blob to the supervisor. True means the
+        supervisor holds a digest-verified copy (synchronous ack — the
+        hot tier's analogue of the disk fence); False means the
+        supervisor is gone and only disk protects this step."""
+        blob = state if isinstance(state, bytes) else serialize_state(state)
+        digest = blob_digest(blob)
+        total = max(1, -(-len(blob) // self.chunk_size))
+        try:
+            with self._lock:
+                _send_frame(self._sock, ('put', identity, int(step), digest,
+                                         extras, total))
+                for index in range(total):
+                    _send_frame(
+                        self._sock,
+                        ('chunk', index,
+                         blob[index * self.chunk_size:
+                              (index + 1) * self.chunk_size]))
+                reply = _recv_frame(self._sock)
+        except OSError as error:
+            self._lost(f'push of {identity!r} step {step}', error)
+            return False
+        if reply is None or reply[0] != 'ok':
+            self._lost(f'push of {identity!r} step {step}',
+                       reply[1] if reply else 'connection closed')
+            return False
+        self._down = False
+        return True
+
+    def fetch(self, identity: str) -> HotState | None:
+        """The supervisor's newest hot state for the identity, or None
+        (missing, digest failed, or the supervisor is unreachable —
+        either way: fall back to disk)."""
+        try:
+            with self._lock:
+                _send_frame(self._sock, ('get', identity))
+                reply = _recv_frame(self._sock)
+                if reply is None or reply[0] == 'none':
+                    return None
+                _, step, digest, extras, total = reply
+                parts = []
+                for _ in range(total):
+                    chunk = _recv_frame(self._sock)
+                    if chunk is None:
+                        return None
+                    parts.append(chunk[2])
+        except OSError as error:
+            self._lost(f'fetch of {identity!r}', error)
+            return None
+        blob = b''.join(parts)
+        if blob_digest(blob) != digest:
+            logger.warning('fetched hot state for %r step %d failed its '
+                           'digest check; treating as absent', identity, step)
+            return None
+        return HotState(step=int(step), digest=digest, blob=blob,
+                        extras=extras)
+
+    def mark(self, stage: str, **info: Any) -> None:
+        """Timeline breadcrumb (``restore``, ``first-step``, ``fence``):
+        fire-and-forget; the supervisor stamps arrival time and folds it
+        into the :class:`~tpusystem.observe.events.RecoveryTimeline`."""
+        try:
+            with self._lock:
+                _send_frame(self._sock, ('mark', stage, dict(info)))
+        except OSError:
+            pass     # a dying supervisor must not take the worker with it
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def supervisor_client(env: dict | None = None) -> MemStoreClient | None:
+    """The worker-side entry: connect to the supervisor named by
+    ``TPUSYSTEM_SUPERVISOR`` (host:port), or None when unsupervised /
+    unreachable — hot state is an accelerator, never a requirement, so a
+    worker that cannot reach its supervisor trains on (disk still
+    checkpoints) instead of refusing to start."""
+    spec = (env if env is not None else os.environ).get(SUPERVISOR_ENV)
+    if not spec:
+        return None
+    host, _, port = spec.rpartition(':')
+    try:
+        return MemStoreClient((host, int(port)))
+    except (OSError, ValueError) as error:
+        logger.warning('supervisor at %r unreachable (%s); hot state '
+                       'disabled for this run', spec, error)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# the restart decision
+
+
+def hot_resume(checkpointer: Any, identity: str, target: Any,
+               client: Any = None) -> tuple[Any, int, Any | None, str]:
+    """Resume preferring hot state over disk: ``(state, step, extras,
+    source)`` with ``source`` in ``{'hot', 'disk'}``.
+
+    The preference is deliberately conservative — RAM wins only when it
+    cannot lose information or integrity:
+
+    * the hot step must be **>= the newest committed disk step** (a stale
+      slot — e.g. pushes stopped while disk saves continued — must not
+      silently rewind training);
+    * the blob's digest must verify (enforced by every fetch surface) and
+      its leaves must match the target's structure/shapes — any mismatch
+      logs and falls back.
+
+    Both paths materialize the same bytes onto the same shardings, so a
+    hot restore is bitwise-identical to restoring the disk checkpoint of
+    the same step (asserted in ``tests/test_supervisor.py``). When
+    ``client`` carries a ``mark`` method the decision is stamped into the
+    recovery timeline as the ``restore`` breadcrumb.
+    """
+    from tpusystem.checkpoint.checkpointer import abstract_like
+    hot = client.fetch(identity) if client is not None else None
+    disk_step = None
+    if hot is not None:
+        disk_step = checkpointer.latest(identity)
+        if disk_step is not None and hot.step < disk_step:
+            logger.warning(
+                'hot state for %r is stale (step %d < committed disk step '
+                '%d); restoring from disk', identity, hot.step, disk_step)
+            hot = None
+    result = None
+    if hot is not None:
+        try:
+            state = deserialize_state(hot.blob, abstract_like(target))
+            result = (state, hot.step, hot.extras, 'hot')
+        except (ValueError, pickle.UnpicklingError) as error:
+            logger.warning('hot state for %r step %d failed to restore '
+                           '(%s); falling back to disk', identity, hot.step,
+                           error)
+    if result is None:
+        state, step, extras = checkpointer.resume(identity, target)
+        result = (state, step, extras, 'disk')
+    mark = getattr(client, 'mark', None)
+    if mark is not None:
+        mark('restore', source=result[3], step=result[1])
+    return result

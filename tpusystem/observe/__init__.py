@@ -12,8 +12,10 @@ has exactly one device→host sync per phase (``metrics.compute()``).
 """
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
-                                      Iterated, ReplicaDiverged, RolledBack,
-                                      StepTimed, Trained, Validated)
+                                      Iterated, RecoveryTimeline,
+                                      ReplicaDiverged, RolledBack, StepTimed,
+                                      Trained, Validated, WorkerExited,
+                                      WorkerRelaunched)
 from tpusystem.observe.ledger import EventLedger, LedgerDivergence
 from tpusystem.observe.logs import logging_consumer
 from tpusystem.observe.profile import StepTimer, annotate, step_span, trace
@@ -26,6 +28,7 @@ from tpusystem.observe.tracking import (
 __all__ = [
     'Trained', 'Validated', 'Iterated', 'StepTimed',
     'AnomalyDetected', 'BackoffApplied', 'RolledBack', 'ReplicaDiverged',
+    'WorkerExited', 'WorkerRelaunched', 'RecoveryTimeline',
     'logging_consumer', 'SummaryWriter', 'tensorboard_consumer',
     'tracking_consumer', 'checkpoint_consumer', 'experiment',
     'metrics_store', 'models_store',

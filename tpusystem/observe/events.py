@@ -95,3 +95,48 @@ class ReplicaDiverged:
     step: int | None
     replicas: list
     leaves: list
+
+
+# --------------------------------------------------------------------------
+# supervisor events — the recovery control loop
+# (tpusystem.parallel.supervisor) narrates every worker exit, relaunch and
+# recovery through the bus, so the ledger orders a whole incident and
+# TensorBoard charts MTTR without any trainer code.
+
+
+@event
+class WorkerExited:
+    """The supervised worker process ended; ``action`` is the contract
+    verdict (``relaunch`` / ``done`` / ``halt`` / ``crash-loop`` /
+    ``drain`` for a forwarded preemption), ``reason`` the human-readable
+    cause (exit-code name or signal)."""
+    rank: int
+    code: int
+    action: str
+    uptime: float
+    reason: str | None = None
+
+
+@event
+class WorkerRelaunched:
+    """The supervisor is restarting the worker after a restartable exit
+    (``backoff`` seconds of capped exponential backoff + jitter already
+    slept)."""
+    rank: int
+    attempt: int
+    restarts: int
+    backoff: float
+
+
+@event
+class RecoveryTimeline:
+    """One full recovery, detect → first-step: ``stages`` maps each
+    breadcrumb (``relaunch``, ``restore``, ``first-step``, plus anything
+    the worker marked) to seconds since detection, ``seconds`` is the
+    whole MTTR, ``source`` where the state came back from
+    (``hot``/``disk``)."""
+    rank: int
+    step: int | None
+    source: str | None
+    seconds: float
+    stages: dict

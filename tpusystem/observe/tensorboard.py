@@ -27,8 +27,9 @@ import struct
 import time
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
-                                      ReplicaDiverged, RolledBack, Trained,
-                                      Validated)
+                                      RecoveryTimeline, ReplicaDiverged,
+                                      RolledBack, Trained, Validated,
+                                      WorkerExited)
 from tpusystem.services.prodcon import Consumer, Depends
 
 # ---------------------------------------------------------------- crc32c ---
@@ -193,5 +194,29 @@ def tensorboard_consumer() -> Consumer:
                             board: SummaryWriter = Depends(writer)) -> None:
         board.add_scalar(f'{_subject(event.model)}/sentinel/sdc_replicas',
                          float(len(event.replicas)), event.step or 0)
+
+    # supervisor recovery loop: worker exits and full detect→first-step
+    # MTTR, charted per rank so a restart storm or a slow restore reads
+    # straight off the dashboard. Exits have no global step, so they are
+    # charted against a per-rank exit counter — ten crash-loop exits read
+    # as ten points, not one overplotted pile at x=0.
+    exit_counts: dict[int, int] = {}
+
+    @consumer.handler
+    def on_worker_exited(event: WorkerExited,
+                         board: SummaryWriter = Depends(writer)) -> None:
+        exit_counts[event.rank] = exit_counts.get(event.rank, 0) + 1
+        board.add_scalar(f'supervisor/rank{event.rank}/exit_code',
+                         float(event.code), exit_counts[event.rank])
+
+    @consumer.handler
+    def on_recovery(event: RecoveryTimeline,
+                    board: SummaryWriter = Depends(writer)) -> None:
+        tag = f'supervisor/rank{event.rank}/recovery_seconds'
+        board.add_scalar(tag, event.seconds, event.step or 0)
+        if event.source is not None:     # 1.0 = hot (RAM), 0.0 = disk
+            board.add_scalar(f'supervisor/rank{event.rank}/restore_hot',
+                             1.0 if event.source == 'hot' else 0.0,
+                             event.step or 0)
 
     return consumer

@@ -5,9 +5,9 @@ from tpusystem.parallel.mesh import (
     single_device_mesh,
 )
 from tpusystem.parallel.multihost import (
-    CollectiveTimeout, ControlPlaneFailover, DistributedProducer,
-    DistributedPublisher, Hub, Loopback, TcpTransport, World, WorkerJoined,
-    WorkerLost, agree, connect, world,
+    BLOB_CHUNK, BlobError, CollectiveTimeout, ControlPlaneFailover,
+    DistributedProducer, DistributedPublisher, Hub, Loopback, TcpTransport,
+    World, WorkerJoined, WorkerLost, agree, connect, world,
 )
 from tpusystem.parallel.collectives import (
     all_gather, all_reduce_mean, all_reduce_sum, all_to_all, axis_index,
@@ -24,11 +24,13 @@ from tpusystem.parallel.pipeline import (PipelineParallel,
 from tpusystem.parallel.chaos import (ChaosHub, ChaosTransport, CorruptBatch,
                                       CorruptGrads, DieAtStep, Faults,
                                       FlipParamBit, WorkerKilled)
-from tpusystem.parallel.recovery import (DIVERGED_EXIT, LOST_WORKER_EXIT,
+from tpusystem.parallel.recovery import (CRASH_LOOP_EXIT, DIVERGED_EXIT,
+                                         FAILURE_EXIT, LOST_WORKER_EXIT,
                                          PREEMPTED_EXIT, RESTART_EXITS,
                                          DivergenceError, Preempted,
                                          WorkerLostError, exit_for_restart,
                                          recovery_consumer)
+from tpusystem.parallel.supervisor import Supervisor
 from tpusystem.parallel.sharding import (
     DataParallel, FullyShardedDataParallel, ShardingPolicy, TensorParallel,
 )
@@ -46,7 +48,8 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'WorkerLost', 'WorkerJoined',
            'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT',
            'Preempted', 'PREEMPTED_EXIT', 'RESTART_EXITS', 'exit_for_restart',
-           'DivergenceError', 'DIVERGED_EXIT',
+           'DivergenceError', 'DIVERGED_EXIT', 'CRASH_LOOP_EXIT',
+           'FAILURE_EXIT', 'Supervisor', 'BlobError', 'BLOB_CHUNK',
            'Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
            'CorruptGrads', 'CorruptBatch', 'FlipParamBit',
            'all_reduce_sum', 'all_reduce_mean', 'all_gather',

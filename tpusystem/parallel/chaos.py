@@ -12,7 +12,10 @@ case instead of a hand-crafted one-off:
 * heartbeat stalls (a healthy-but-slow host crossing the liveness timeout);
 * mid-collective socket kills (the crashed-host signature: EOF, no 'bye');
 * worker death at a chosen global step (:class:`DieAtStep` — the
-  kill-at-step-k → restart → step-granular-resume drill).
+  kill-at-step-k → restart → step-granular-resume drill);
+* blob-plane faults (dropped or truncated chunks of a hot-state replica,
+  ``Faults(truncate=...)`` / ``kinds=('blob',)``) — the transfers the
+  supervisor's memstore replication rides must *detect* every torn copy.
 
 Determinism: every fault decision is drawn in frame order from one
 ``random.Random(seed)`` per :class:`Faults` instance, and frames of one
@@ -51,10 +54,17 @@ class Faults:
         drop: probability a matching frame is silently discarded.
         delay: probability a matching frame is held for ``delay_seconds``.
         delay_seconds: hold time for delayed frames.
+        truncate: probability a matching ``blob`` chunk frame is sent with
+            half its payload cut off — the torn-transfer signature the
+            receiver's whole-blob digest must catch (non-blob frames treat
+            a truncate verdict as a pass; the pickle framing would turn a
+            torn control frame into a socket death, which ``kill()``
+            already scripts precisely).
         kinds: frame kinds eligible for faults (None: every kind not in
             ``spare``). Transport frame kinds: ``event``, ``reduce``,
-            ``gather``, ``hb``; hub fanout kinds add ``result``, ``lost``,
-            ``joined``.
+            ``gather``, ``hb``, plus the blob plane's ``blob`` /
+            ``blob-req`` / ``blob-nak``; hub fanout kinds add ``result``,
+            ``lost``, ``joined``.
         spare: kinds never faulted. By default: handshake/teardown frames
             (drop those and a scenario tests the dialer's retry loop,
             usually not what it meant); ``result`` (dropping a collective's
@@ -71,6 +81,7 @@ class Faults:
     drop: float = 0.0
     delay: float = 0.0
     delay_seconds: float = 0.02
+    truncate: float = 0.0
     kinds: tuple[str, ...] | None = None
     spare: tuple[str, ...] = ('hello', 'bye', 'peer', 'standby', 'rejected',
                               'result', 'hb')
@@ -81,9 +92,11 @@ class Faults:
         self._stall_until = 0.0
         self.dropped: list[str] = []    # observability for assertions
         self.delayed: list[str] = []
+        self.truncated: list[str] = []
 
-    def decide(self, kind: str) -> float | None:
-        """One decision: None = drop the frame, 0.0 = pass, >0 = delay.
+    def verdict(self, kind: str) -> str:
+        """One decision: ``'drop'`` | ``'delay'`` | ``'truncate'`` |
+        ``'pass'``.
 
         An explicit ``kinds`` list overrides ``spare`` — naming a kind is
         the opt-in for faulting even default-spared traffic (``result``,
@@ -92,17 +105,32 @@ class Faults:
         sequence — not on which probabilities are enabled."""
         if self.kinds is not None:
             if kind not in self.kinds:
-                return 0.0
+                return 'pass'
         elif kind in self.spare:
-            return 0.0
+            return 'pass'
         with self._lock:
             roll = self._rng.random()
             if roll < self.drop:
                 self.dropped.append(kind)
-                return None
+                return 'drop'
             if roll < self.drop + self.delay:
                 self.delayed.append(kind)
-                return self.delay_seconds
+                return 'delay'
+            if roll < self.drop + self.delay + self.truncate:
+                self.truncated.append(kind)
+                return 'truncate'
+        return 'pass'
+
+    def decide(self, kind: str) -> float | None:
+        """Verdict as the legacy drop/delay encoding: None = drop the
+        frame, 0.0 = pass, >0 = delay. (A ``truncate`` verdict on a
+        non-blob path reads as pass — only :class:`ChaosTransport`'s blob
+        frames can act on it.)"""
+        verdict = self.verdict(kind)
+        if verdict == 'drop':
+            return None
+        if verdict == 'delay':
+            return self.delay_seconds
         return 0.0
 
     def stall_heartbeats(self, seconds: float) -> None:
@@ -132,11 +160,16 @@ class ChaosTransport(TcpTransport):
         kind = frame[0]
         if kind == 'hb' and self.faults.heartbeats_stalled:
             return                       # the beat never leaves the host
-        verdict = self.faults.decide(kind)
-        if verdict is None:
+        verdict = self.faults.verdict(kind)
+        if verdict == 'drop':
             return                       # dropped on the (virtual) wire
-        if verdict > 0:
-            time.sleep(verdict)
+        if verdict == 'delay':
+            time.sleep(self.faults.delay_seconds)
+        if verdict == 'truncate' and kind == 'blob':
+            # half the chunk's bytes never make it: the reassembled blob
+            # fails its whole-blob digest at the receiver
+            chunk = frame[-1]
+            frame = frame[:-1] + (chunk[:len(chunk) // 2],)
         super()._send(frame, op_key)
 
     def kill(self) -> None:

@@ -40,7 +40,9 @@ host values — never device arrays.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
+import logging
 import pickle
 import queue
 import socket
@@ -53,6 +55,8 @@ from typing import Any
 
 from tpusystem.services.prodcon import Consumer, Producer, event
 from tpusystem.services.pubsub import Publisher, Subscriber
+
+logger = logging.getLogger('tpusystem.multihost')
 
 # ---------------------------------------------------------------------------
 # world
@@ -97,10 +101,19 @@ def initialize(coordinator_address: str | None = None,
 
 @event
 class WorkerLost:
-    """A host stopped heartbeating; consumers decide the recovery policy
-    (checkpoint-restore restart, mesh re-init, abort)."""
+    """A host left the pod; consumers decide the recovery policy
+    (checkpoint-restore restart, mesh re-init, abort).
+
+    ``reason`` records *how* the loss was detected: ``'socket'`` — the
+    connection died without a ``bye`` (crash/SIGKILL, seen immediately) —
+    vs ``'heartbeat'`` — the host went silent past the liveness timeout
+    (alive-but-wedged: GC pause, hung NFS, a stuck collective). The two
+    have different MTTR profiles (a stall eats the whole timeout before
+    recovery starts), so the ledger and recovery timeline distinguish
+    them."""
     rank: int
     last_seen: float
+    reason: str = 'socket'
 
 
 @event
@@ -143,6 +156,28 @@ def _recv_frame(sock: socket.socket) -> tuple | None:
 _REJECTED = object()
 # client-local sentinel: the active hub died mid-collective (failover)
 _FAILED_OVER = object()
+# client-local sentinels for fetch_blob: peer has no such blob / the
+# reassembled bytes failed their digest (a chunk was truncated in flight) /
+# the transport died or failed over with the fetch in flight
+_BLOB_NAK = object()
+_BLOB_CORRUPT = object()
+_BLOB_DEAD = object()
+
+# bound on a single blob frame's payload: large transfers (hot TrainState
+# replicas) are chunked so one blob cannot monopolize the control-plane
+# socket — heartbeats and collective frames interleave between chunks
+BLOB_CHUNK = 1 << 20
+
+
+def _blob_digest(data: bytes) -> str:
+    return hashlib.blake2b(data, digest_size=16).hexdigest()
+
+
+class BlobError(RuntimeError):
+    """A point-to-point blob transfer failed (peer had no such blob, a
+    chunk was lost/truncated in flight, or the wait timed out). Blobs are
+    a best-effort sidecar of the control plane — the caller decides the
+    fallback (for hot state: restore from disk)."""
 
 
 class ControlPlaneFailover(RuntimeError):
@@ -197,6 +232,13 @@ class Hub:
         self.address = self._server.getsockname()
         self._clients: dict[int, socket.socket] = {}
         self._locks = threading.Lock()
+        # one send lock per client: hub threads (client loops routing blob
+        # chunks, the monitor's lost fanout, the accept loop's joined
+        # fanout) write concurrently to the same sockets, and a 1 MiB blob
+        # chunk's sendall can interleave mid-frame with another thread's
+        # frame — a torn length-prefixed stream desyncs the client for
+        # good. Small frames rode single send() calls; blobs ended that.
+        self._send_locks: dict[int, threading.Lock] = {}
         self._pending: dict[tuple, list] = {}
         self._last_seen: dict[int, float] = {}
         self._lost: set[int] = set()
@@ -298,6 +340,7 @@ class Hub:
             rank = frame[1]
             with self._locks:
                 self._clients[rank] = sock
+                self._send_locks.setdefault(rank, threading.Lock())
                 self._last_seen[rank] = time.monotonic()
                 self._lost.discard(rank)     # a rejoining worker is alive
                 # NOT removed from _excluded: see _live()
@@ -343,7 +386,7 @@ class Hub:
                         self._excluded.add(rank)
                 sock.close()
                 if crashed:
-                    self._fanout(('lost', rank, last_seen))
+                    self._fanout(('lost', rank, last_seen, 'socket'))
                 # either way the rank can no longer contribute: complete
                 # collectives that were only waiting on it
                 self._complete_satisfied()
@@ -354,16 +397,26 @@ class Hub:
             kind = frame[0]
             if kind == 'hb':
                 continue
-            if self._standby.is_set() and kind in ('event', 'reduce', 'gather'):
+            if self._standby.is_set() and kind in ('event', 'reduce', 'gather',
+                                                   'blob', 'blob-req',
+                                                   'blob-nak'):
                 # not the active hub: tell the client to go back to the
                 # primary (its link may have flaked while the primary lives)
-                try:
-                    _send_frame(sock, ('standby',))
-                except OSError:
-                    pass
+                self._send_to(rank, sock, ('standby',))
                 continue
             if kind == 'event':
                 self._fanout(frame, exclude=rank)
+            elif kind in ('blob', 'blob-req', 'blob-nak'):
+                # point-to-point: route to the addressee only, rewriting the
+                # 'to' slot into 'from' so the receiver can answer. Blobs are
+                # best-effort (the sidecar of the control plane): an absent
+                # addressee just drops the frame — the requester's timeout
+                # (or the replica's previous copy) is the fallback.
+                to = frame[1]
+                with self._locks:
+                    target = self._clients.get(to)
+                if target is not None:
+                    self._send_to(to, target, (kind, rank) + frame[2:])
             elif kind in ('reduce', 'gather'):
                 _, op_key, value = frame
                 with self._locks:
@@ -383,10 +436,7 @@ class Hub:
                         if done:
                             del self._pending[op_key]
                 if excluded:
-                    try:
-                        _send_frame(sock, ('rejected', op_key))
-                    except OSError:
-                        pass
+                    self._send_to(rank, sock, ('rejected', op_key))
                     continue
                 if done:
                     self._emit_result(op_key, values)
@@ -414,9 +464,20 @@ class Hub:
                 self._lost.update(rank for rank, _ in stale)
                 self._excluded.update(rank for rank, _ in stale)
             for rank, seen in stale:
-                self._fanout(('lost', rank, seen))
+                self._fanout(('lost', rank, seen, 'heartbeat'))
             if stale:
                 self._complete_satisfied()
+
+    def _send_to(self, rank: int, sock: socket.socket, frame: tuple) -> None:
+        """Serialize whole frames per client socket (see ``_send_locks``);
+        a dead link is the receiver's problem, not the sender thread's."""
+        with self._locks:
+            lock = self._send_locks.setdefault(rank, threading.Lock())
+        with lock:
+            try:
+                _send_frame(sock, frame)
+            except OSError:
+                pass
 
     def _live(self) -> set[int]:
         """Ranks a collective must wait for. The quota only ever shrinks:
@@ -461,14 +522,11 @@ class Hub:
     def _fanout(self, frame: tuple, exclude: int | None = None,
                 live_only: bool = False) -> None:
         with self._locks:
-            targets = [sock for rank, sock in self._clients.items()
+            targets = [(rank, sock) for rank, sock in self._clients.items()
                        if rank != exclude
                        and not (live_only and rank in self._excluded)]
-        for sock in targets:
-            try:
-                _send_frame(sock, frame)
-            except OSError:
-                pass
+        for rank, sock in targets:
+            self._send_to(rank, sock, frame)
 
     def close(self) -> None:
         self._closed.set()
@@ -501,6 +559,8 @@ class Loopback:
     def __init__(self) -> None:
         self._channels: dict[str, Callable[[Any], None]] = {}
         self.on_control: Callable[[tuple], None] | None = None
+        self.on_blob: Callable[[int, str, bytes], None] | None = None
+        self.on_blob_request: Callable[[str], bytes | None] | None = None
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
         """Register the receiver for one named event channel (each bus owns
@@ -509,6 +569,18 @@ class Loopback:
 
     def send_event(self, channel: str, message: Any) -> None:
         pass
+
+    def send_blob(self, to: int, key: str, data: bytes,
+                  chunk_size: int = BLOB_CHUNK) -> None:
+        if self.on_blob is not None:
+            self.on_blob(0, key, bytes(data))
+
+    def fetch_blob(self, peer: int, key: str, timeout: float = 30.0) -> bytes:
+        data = (self.on_blob_request(key)
+                if self.on_blob_request is not None else None)
+        if data is None:
+            raise BlobError(f'no blob {key!r} on the loopback transport')
+        return bytes(data)
 
     def allreduce(self, value: Any, op: str = 'and') -> Any:
         return _REDUCERS[op]([value])
@@ -554,6 +626,20 @@ class TcpTransport:
         # _FAILED_OVER when the active hub died (delivery state unknown)
         self._pending_sends: dict[tuple, tuple] = {}
         self._counter = itertools.count()
+        # point-to-point blob plane (chunked, digest-verified): in-flight
+        # reassemblies, completed unclaimed blobs, and fetch_blob waiters
+        self.blob_chunk = BLOB_CHUNK   # per-frame payload bound
+        self._blob_lock = threading.Lock()
+        self._blob_parts: dict[tuple, dict] = {}
+        self._blob_ready: dict[str, tuple[int, bytes]] = {}
+        # fetch waiters are keyed by blob key but pinned to the peer the
+        # request went to: a same-key blob arriving from anyone else (e.g.
+        # the buddy's own concurrent push) must not satisfy the fetch.
+        # The request frame rides along so a standby bounce / redial can
+        # replay it (a deputy deterministically drops blob-reqs).
+        self._blob_waiters: dict[str, tuple[int, queue.Queue, tuple]] = {}
+        self.on_blob: Callable[[int, str, bytes], None] | None = None
+        self.on_blob_request: Callable[[str], bytes | None] | None = None
         self._closed = threading.Event()
         self._reconnected = threading.Event()
         self._dead = False       # set when every failover avenue is spent
@@ -668,6 +754,17 @@ class TcpTransport:
                     box = self._results.get(frame[1])
                 if box is not None:
                     box.put(_REJECTED)
+            elif kind == 'blob':
+                _, sender, key, index, total, digest, chunk = frame
+                self._blob_accept(sender, key, index, total, digest, chunk)
+            elif kind == 'blob-req':
+                _, sender, key = frame
+                self._answer_blob_request(sender, key)
+            elif kind == 'blob-nak':
+                with self._blob_lock:
+                    waiter = self._blob_waiters.get(frame[2])
+                if waiter is not None and waiter[0] == frame[1]:
+                    waiter[1].put(_BLOB_NAK)
             elif kind in ('lost', 'joined'):
                 if self.on_control is not None:
                     self.on_control(frame)
@@ -681,6 +778,7 @@ class TcpTransport:
             boxes = list(self._results.values())
         for box in boxes:
             box.put(_FAILED_OVER)
+        self._fail_blob_waiters()
         if len(self._addresses) == 1:
             return False
         return self._redial((self._active + 1) % len(self._addresses),
@@ -696,6 +794,16 @@ class TcpTransport:
             boxes = list(self._results.values())
         for box in boxes:
             box.put(_FAILED_OVER)
+        self._fail_blob_waiters()
+
+    def _fail_blob_waiters(self) -> None:
+        """Fail in-flight blob fetches typed and fast when the transport
+        dies or fails over — the same no-hang-to-timeout discipline the
+        collective waiters get (their delivery state is unknowable)."""
+        with self._blob_lock:
+            waiters = list(self._blob_waiters.values())
+        for waiter in waiters:
+            waiter[1].put(_BLOB_DEAD)
 
     def _redial(self, index: int, *, replay: bool,
                 connect_timeout: float = 30.0) -> bool:
@@ -718,6 +826,11 @@ class TcpTransport:
         if replay:
             with self._results_lock:
                 pending = list(self._pending_sends.values())
+            # in-flight blob requests too: a standby deputy deterministically
+            # dropped them, and without a replay the fetch would ride out
+            # its full timeout against a healthy primary
+            with self._blob_lock:
+                pending += [waiter[2] for waiter in self._blob_waiters.values()]
             for frame in pending:
                 try:
                     self._send(frame)
@@ -771,6 +884,156 @@ class TcpTransport:
                 'timed out, or restarted); re-admission is the restart-resume '
                 'cycle — see tpusystem.parallel.recovery')
         return result
+
+    # ------------------------------------------------------------------
+    # blob plane: chunked, digest-verified point-to-point byte transfers.
+    # The control plane's collectives and events carry small host values;
+    # blobs carry the occasional BIG one — a serialized hot TrainState
+    # replica shipped between supervisors (tpusystem.parallel.supervisor).
+    # Bounded frames (BLOB_CHUNK) keep heartbeats and collective traffic
+    # interleaving with a transfer; the whole-blob digest makes any lost,
+    # truncated, or reordered-into-oblivion chunk a *detected* failure.
+
+    def send_blob(self, to: int, key: str, data: bytes,
+                  chunk_size: int | None = None) -> None:
+        """Ship ``data`` to rank ``to`` under ``key`` (fire-and-forget).
+
+        The receiver reassembles and digest-verifies; a corrupt or
+        incomplete transfer is discarded there (logged), never delivered —
+        best-effort by design: the replication rider keeps its previous
+        copy, a fetcher times out and falls back. ``chunk_size`` defaults
+        to the transport's ``blob_chunk`` bound.
+        """
+        chunk_size = chunk_size or self.blob_chunk
+        data = bytes(data)
+        digest = _blob_digest(data)
+        total = max(1, -(-len(data) // chunk_size))
+        for index in range(total):
+            chunk = data[index * chunk_size:(index + 1) * chunk_size]
+            self._send(('blob', to, key, index, total, digest, chunk))
+
+    def fetch_blob(self, peer: int, key: str, timeout: float = 30.0) -> bytes:
+        """Request blob ``key`` from rank ``peer`` and wait for it.
+
+        The peer answers from its ``on_blob_request`` hook (or NAKs when
+        it has nothing). Raises :class:`BlobError` on NAK, digest
+        mismatch, or timeout — callers treat all three as "no hot copy"
+        and fall back (for checkpoints: to disk).
+        """
+        with self._blob_lock:
+            ready = self._blob_ready.pop(key, None)
+            if ready is not None and ready[0] != peer:
+                # a same-key blob someone ELSE pushed is not this answer
+                self._blob_ready[key] = ready
+                ready = None
+            if ready is None:
+                if key in self._blob_waiters:
+                    # one waiter registration per key: a second concurrent
+                    # fetch would clobber the first's (and its finally
+                    # would then strand the second) — refuse typed instead
+                    raise BlobError(
+                        f'rank {self.rank}: a fetch for blob {key!r} is '
+                        f'already in flight on this transport')
+                box = queue.Queue()
+                self._blob_waiters[key] = (peer, box,
+                                           ('blob-req', peer, key))
+        if ready is not None:
+            return ready[1]
+        try:
+            try:
+                self._send(('blob-req', peer, key))
+            except OSError as error:
+                raise BlobError(
+                    f'rank {self.rank}: could not request blob {key!r} '
+                    f'from rank {peer}: {error}') from error
+            try:
+                result = box.get(timeout=timeout)
+            except queue.Empty:
+                raise BlobError(
+                    f'rank {self.rank}: blob {key!r} from rank {peer} did '
+                    f'not arrive within {timeout:.0f}s (dropped chunk, dead '
+                    f'peer, or nothing to send)') from None
+        finally:
+            with self._blob_lock:
+                self._blob_waiters.pop(key, None)
+        if result is _BLOB_NAK:
+            raise BlobError(f'rank {peer} has no blob {key!r}')
+        if result is _BLOB_CORRUPT:
+            raise BlobError(
+                f'blob {key!r} from rank {peer} failed its digest check '
+                f'(truncated or corrupted chunk)')
+        if result is _BLOB_DEAD:
+            raise BlobError(
+                f'rank {self.rank}: transport closed or failed over while '
+                f'fetching blob {key!r}; delivery state unknown')
+        return result[1]
+
+    def _answer_blob_request(self, sender: int, key: str) -> None:
+        data = None
+        if self.on_blob_request is not None:
+            try:
+                data = self.on_blob_request(key)
+            except Exception:
+                logger.exception('on_blob_request(%r) failed; NAKing', key)
+        try:
+            if data is None:
+                self._send(('blob-nak', sender, key))
+            else:
+                self.send_blob(sender, key, data)
+        except OSError:
+            # best-effort reply: the link (or this transport) died while
+            # answering — the requester's own timeout is the fallback
+            pass
+
+    def _blob_accept(self, sender: int, key: str, index: int, total: int,
+                     digest: str, chunk: bytes) -> None:
+        slot = (sender, key, digest)
+        now = time.monotonic()
+        with self._blob_lock:
+            entry = self._blob_parts.setdefault(slot, {'chunks': {},
+                                                       'touched': now})
+            entry['chunks'][index] = chunk
+            entry['touched'] = now
+            parts = entry['chunks']
+            if len(parts) < total:
+                # bound abandoned reassemblies: a transfer whose chunk was
+                # dropped in flight never completes, and without eviction
+                # each partial (potentially a multi-GB hot TrainState)
+                # would hold its bytes forever. Only *stale* slots (no
+                # chunk for 120s) are evicted — a big transfer that merely
+                # started first is still live — and the sweep runs on
+                # every arrival, not past some count: even ONE abandoned
+                # partial is a leak worth collecting.
+                for stale, held in list(self._blob_parts.items()):
+                    if stale != slot and now - held['touched'] > 120.0:
+                        del self._blob_parts[stale]
+                        logger.warning(
+                            'evicted stale blob reassembly %r from '
+                            'rank %d', stale[1], stale[0])
+                return
+            del self._blob_parts[slot]
+        data = b''.join(parts[i] for i in sorted(parts))
+        if _blob_digest(data) != digest:
+            logger.warning('blob %r from rank %d failed its digest check; '
+                           'discarded', key, sender)
+            self._blob_deliver(key, sender, _BLOB_CORRUPT)
+            return
+        self._blob_deliver(key, sender, data)
+
+    def _blob_deliver(self, key: str, sender: int, payload: Any) -> None:
+        with self._blob_lock:
+            waiter = self._blob_waiters.get(key)
+            if waiter is not None and waiter[0] != sender:
+                waiter = None            # not the peer this fetch asked
+            if waiter is None and isinstance(payload, bytes):
+                if self.on_blob is None:
+                    self._blob_ready[key] = (sender, payload)
+                    return
+        if waiter is not None:
+            marker = payload is _BLOB_NAK or payload is _BLOB_CORRUPT
+            waiter[1].put(payload if marker else (sender, payload))
+        elif isinstance(payload, bytes) and self.on_blob is not None:
+            self.on_blob(sender, key, payload)
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> None:
         """Register the receiver for one named event channel."""
@@ -872,7 +1135,9 @@ class DistributedProducer(Producer):
 
         def on_control(frame: tuple) -> None:
             if frame[0] == 'lost':
-                self._inbox.put(WorkerLost(rank=frame[1], last_seen=frame[2]))
+                self._inbox.put(WorkerLost(
+                    rank=frame[1], last_seen=frame[2],
+                    reason=frame[3] if len(frame) > 3 else 'socket'))
             elif frame[0] == 'joined':
                 self._inbox.put(WorkerJoined(rank=frame[1]))
             if previous is not None:

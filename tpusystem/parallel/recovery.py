@@ -49,22 +49,43 @@ logger = logging.getLogger('tpusystem.recovery')
 # the sentinel's bounded give-up (DivergenceError): deliberately NOT in
 # RESTART_EXITS — a blind relaunch of a deterministic divergence replays
 # it; launchers should halt for triage (or cap automatic retries and
-# adjust hyperparameters between attempts).
+# adjust hyperparameters between attempts). 45 is emitted by the
+# *launcher* side (:class:`tpusystem.parallel.Supervisor`) when the worker
+# crash-loops: restartable exits kept arriving within seconds of launch,
+# so relaunching has stopped making progress — halt for triage. 1 is the
+# generic non-restart failure (an unrecognized exception is a bug, not a
+# recoverable fault — relaunching it forever would hide it).
 LOST_WORKER_EXIT = 42
 PREEMPTED_EXIT = 43
 DIVERGED_EXIT = 44
+CRASH_LOOP_EXIT = 45
+FAILURE_EXIT = 1
 RESTART_EXITS = frozenset({LOST_WORKER_EXIT, PREEMPTED_EXIT})
 
 
 class WorkerLostError(RuntimeError):
-    """A peer host died; the job should checkpoint-fence and restart."""
+    """A peer host died; the job should checkpoint-fence and restart.
 
-    def __init__(self, rank: int, last_seen: float):
+    ``reason`` distinguishes the two detection paths — ``'socket'`` (the
+    peer's connection died without a ``bye``: a crash or SIGKILL,
+    detected immediately) vs ``'heartbeat'`` (the peer stopped
+    heartbeating: alive-but-wedged, detected only after the liveness
+    timeout). The two have different MTTR profiles — a socket death is
+    seen in milliseconds, a heartbeat stall costs the full timeout before
+    recovery even *starts* — so the ledger and recovery timeline record
+    which one fired.
+    """
+
+    def __init__(self, rank: int, last_seen: float, reason: str = 'socket'):
+        detail = ('socket death' if reason == 'socket'
+                  else f'{reason} stall past the liveness timeout')
         super().__init__(
-            f'worker {rank} lost (last heartbeat at t={last_seen:.1f}); '
-            'restart the job to resume from the last committed checkpoint')
+            f'worker {rank} lost to {detail} (last heartbeat at '
+            f't={last_seen:.1f}); restart the job to resume from the last '
+            'committed checkpoint')
         self.rank = rank
         self.last_seen = last_seen
+        self.reason = reason
 
 
 class Preempted(RuntimeError):
@@ -119,12 +140,20 @@ def exit_for_restart(reason: BaseException) -> SystemExit:
     lost / 43 preempted) relaunch the job and resume from the last
     committed checkpoint; :data:`DIVERGED_EXIT` (44, from
     :class:`DivergenceError`) halts for triage.
+
+    Only the three recovery exceptions map to contract codes. Anything
+    else — a plain ``ValueError``, ``KeyboardInterrupt``, an assertion —
+    is a *bug*, not a recoverable fault, and returns the generic
+    :data:`FAILURE_EXIT`: mapping unknown exceptions to a restartable
+    code (the old behavior) would relaunch a deterministic crash forever.
     """
+    if isinstance(reason, WorkerLostError):
+        return SystemExit(LOST_WORKER_EXIT)
     if isinstance(reason, Preempted):
         return SystemExit(PREEMPTED_EXIT)
     if isinstance(reason, DivergenceError):
         return SystemExit(DIVERGED_EXIT)
-    return SystemExit(LOST_WORKER_EXIT)
+    return SystemExit(FAILURE_EXIT)
 
 
 def recovery_consumer(policy: str = 'abort') -> Consumer:
@@ -141,9 +170,9 @@ def recovery_consumer(policy: str = 'abort') -> Consumer:
     @consumer.handler
     def on_worker_lost(event: WorkerLost) -> None:
         if policy == 'abort':
-            raise WorkerLostError(event.rank, event.last_seen)
-        logger.warning('worker %d lost (last seen t=%.1f); continuing',
-                       event.rank, event.last_seen)
+            raise WorkerLostError(event.rank, event.last_seen, event.reason)
+        logger.warning('worker %d lost (%s, last seen t=%.1f); continuing',
+                       event.rank, event.reason, event.last_seen)
 
     @consumer.handler
     def on_worker_joined(event: WorkerJoined) -> None:

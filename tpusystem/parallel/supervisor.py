@@ -1,0 +1,484 @@
+"""Supervising launcher: the restart contract, enforced by a process.
+
+PR 3–4 defined the contract — 42 worker-lost / 43 preempted relaunch, 44
+diverged halts (:mod:`tpusystem.parallel.recovery`) — but until now the
+launcher side existed only as prose: nothing in the tree relaunched a
+worker, detected a crash loop, or bounded a restart storm, and every
+recovery paid a full disk restore. :class:`Supervisor` closes that loop
+the way production systems do (MegaScale's driver-side fault recovery;
+Gemini's redundant in-memory model-state copies):
+
+* **spawn + verdict** — the worker runs as a subprocess; its exit code is
+  mapped per the contract: :data:`~tpusystem.parallel.recovery.
+  RESTART_EXITS` (and signal deaths — a SIGKILLed worker *is* the
+  worker-lost case) relaunch with capped exponential backoff + jitter;
+  :data:`~tpusystem.parallel.recovery.DIVERGED_EXIT` and every unknown
+  code halt for triage (relaunching a deterministic failure replays it).
+* **crash-loop containment** — ``crash_loop_k`` consecutive restartable
+  exits, each within ``crash_loop_window`` seconds of the worker's
+  first-step mark (or of launch, when it never got that far), end the
+  loop with the distinct
+  :data:`~tpusystem.parallel.recovery.CRASH_LOOP_EXIT` instead of
+  relaunching forever.
+* **clean preemption** — the scheduler SIGTERMs the *supervisor*;
+  :meth:`terminate` (or the installed handler) forwards it to the worker
+  and waits ``grace`` seconds so the worker's preemption path
+  (``Runtime(preemption=True)`` → fence → exit 43) drains, escalating to
+  SIGKILL only after the grace expires. The supervisor then exits with
+  the worker's code — it is being evicted too, so no relaunch.
+* **hot state** — the supervisor owns a :class:`~tpusystem.checkpoint.
+  memstore.MemStore` served to the worker over a local socket
+  (``TPUSYSTEM_SUPERVISOR``), so a relaunched worker restores from the
+  supervisor's RAM in seconds instead of from disk; with a control-plane
+  ``transport`` and a ``buddy`` rank each verified push is
+  cross-replicated to the buddy host's supervisor
+  (``TcpTransport.send_blob``) and a replaced host pulls its state back
+  from its buddy. Disk remains the verified fallback at every rung
+  (:func:`~tpusystem.checkpoint.memstore.hot_resume`).
+* **recovery timeline** — every exit, relaunch and detect→first-step
+  recovery is a domain event (:class:`~tpusystem.observe.events.
+  WorkerExited` / ``WorkerRelaunched`` / ``RecoveryTimeline``) on the
+  supervisor's producer, so the ledger orders an incident and TensorBoard
+  charts MTTR with zero trainer code.
+
+The loop is fully injectable (``popen``/``clock``/``sleep``), so backoff
+and crash-loop policy are tier-1-testable without subprocesses or real
+sleeps (``tests/test_supervisor.py``).
+
+Typical launcher ``main()``::
+
+    supervisor = Supervisor([sys.executable, 'train.py'], producer=bus)
+    supervisor.install_signal_handler()     # SIGTERM -> forward + grace
+    raise SystemExit(supervisor.run())
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import os
+import random
+import signal as signal_module
+import subprocess
+import threading
+import time
+from typing import TYPE_CHECKING, Any, Callable
+
+from tpusystem.parallel.multihost import BlobError
+from tpusystem.parallel.recovery import (CRASH_LOOP_EXIT, DIVERGED_EXIT,
+                                         PREEMPTED_EXIT, RESTART_EXITS)
+
+if TYPE_CHECKING:  # deferred at runtime: memstore pulls in the (orbax-
+    # backed) checkpoint package, which must not tax `import
+    # tpusystem.parallel` — the hot tier loads on first Supervisor(...)
+    from tpusystem.checkpoint.memstore import MemStore, MemStoreServer
+
+logger = logging.getLogger('tpusystem.supervisor')
+
+__all__ = ['Supervisor']
+
+_CODE_NAMES = {0: 'completed', 42: 'worker-lost', 43: 'preempted',
+               44: 'diverged'}
+
+
+def _describe(code: int) -> str:
+    if code < 0:
+        try:
+            return f'signal {signal_module.Signals(-code).name}'
+        except ValueError:
+            return f'signal {-code}'
+    return _CODE_NAMES.get(code, f'exit {code}')
+
+
+class Supervisor:
+    """Seconds-scale recovery control loop around one worker process.
+
+    Args:
+        argv: the worker command line (relaunched verbatim).
+        rank: this host's rank — carried in events and used to pair with
+            ``buddy`` for replication.
+        memstore: ``True`` (default) serves a fresh
+            :class:`~tpusystem.checkpoint.memstore.MemStore` to the
+            worker; pass an existing store to share one, or ``False`` to
+            disable the hot tier entirely (workers then restore from
+            disk — the drill for the fallback path).
+        transport: optional control-plane client
+            (:class:`~tpusystem.parallel.multihost.TcpTransport`) of the
+            *supervisor* pod — the channel hot state is cross-replicated
+            over. Independent of the workers' control plane: it must
+            survive worker death.
+        buddy: peer rank this supervisor mirrors its hot state to (and
+            pulls from when its own store is empty — the replaced-host
+            path). Pairing is 1:1 by convention (e.g. ``rank ^ 1``).
+        producer: event bus the supervisor narrates on (``dispatch`` is
+            called on the supervising thread only).
+        env: extra environment entries for the worker (on top of
+            ``os.environ`` and the memstore address).
+        backoff_base / backoff_cap / backoff_jitter / seed: relaunch
+            backoff ``min(cap, base * 2**attempt)`` scaled by
+            ``1 + jitter * U[0, 1)`` from a seeded RNG — capped
+            exponential with deterministic jitter, reset by a productive
+            run.
+        crash_loop_k / crash_loop_window: give up (exit
+            :data:`~tpusystem.parallel.recovery.CRASH_LOOP_EXIT`) after
+            ``k`` consecutive restartable exits each within ``window``
+            seconds of first-step (or launch).
+        max_restarts: optional hard cap on total relaunches (``None`` =
+            bounded by the crash-loop detector only).
+        grace: seconds between forwarding SIGTERM and escalating to
+            SIGKILL.
+        popen / clock / sleep / poll_interval: injection seams — tests
+            drive the whole policy with a fake clock and fake processes,
+            no real sleeps in tier-1.
+    """
+
+    def __init__(self, argv: list[str], *, rank: int = 0,
+                 memstore: MemStore | bool = True,
+                 transport: Any = None, buddy: int | None = None,
+                 producer: Any = None, env: dict[str, str] | None = None,
+                 backoff_base: float = 1.0, backoff_cap: float = 30.0,
+                 backoff_jitter: float = 0.25, seed: int = 0,
+                 crash_loop_k: int = 3, crash_loop_window: float = 30.0,
+                 max_restarts: int | None = None, grace: float = 15.0,
+                 popen: Callable[..., Any] = subprocess.Popen,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 poll_interval: float = 0.05) -> None:
+        self.argv = list(argv)
+        self.rank = rank
+        self.transport = transport
+        self.buddy = buddy
+        self.producer = producer
+        self.env = dict(env or {})
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.backoff_jitter = backoff_jitter
+        self.crash_loop_k = crash_loop_k
+        self.crash_loop_window = crash_loop_window
+        self.max_restarts = max_restarts
+        self.grace = grace
+        self._popen = popen
+        self._clock = clock
+        self._sleep = sleep
+        self._poll_interval = poll_interval
+        self._rng = random.Random(seed)
+        self._terminate = threading.Event()
+        self._repl_lock = threading.Lock()
+        self._repl_pending: dict[str, Any] = {}
+        self._repl_wake = threading.Event()
+        self._repl_stop = threading.Event()
+        self._repl_thread: threading.Thread | None = None
+        self._marks: collections.deque = collections.deque()
+        self._timeline: dict[str, float] | None = None
+        self._restore_info: dict | None = None
+        self._first_step_at: float | None = None
+        self.restarts = 0
+        self.timelines: list[Any] = []    # emitted RecoveryTimeline events
+        self.store: MemStore | None = None
+        self.server: MemStoreServer | None = None
+        if memstore:
+            from tpusystem.checkpoint.memstore import MemStore, MemStoreServer
+            self.store = (memstore if isinstance(memstore, MemStore)
+                          else MemStore())
+            self.server = MemStoreServer(
+                self.store, on_put=self._replicate, on_mark=self._on_mark,
+                fetch_fallback=self._pull_from_buddy)
+        if transport is not None:
+            transport.on_blob = self._accept_replica
+            transport.on_blob_request = self._serve_replica
+
+    # ------------------------------------------------------------------
+    # hot-state replication (buddy pair over the control plane)
+
+    # key discipline: pushes travel as 'replica:{identity}' and pulls ask
+    # for 'hot:{identity}' — distinct keys, so a replaced host's pull can
+    # never be satisfied by the buddy's own concurrent push of ITS state
+    # (fetch_blob additionally pins the sender, but the key split keeps
+    # the two flows unmistakable on the wire)
+
+    def _replicate(self, identity: str, entry: Any) -> None:
+        """Queue a verified push for cross-host replication.
+
+        Runs on the memstore serve thread — the transfer itself must NOT:
+        the worker's next ``push`` ack waits behind this thread, and a
+        slow buddy link would inject the whole cross-host transfer into
+        the training loop. A background worker drains the queue, and
+        entries coalesce per identity (only the newest matters)."""
+        if self.transport is None or self.buddy is None:
+            return
+        with self._repl_lock:
+            self._repl_pending[identity] = entry
+            if self._repl_thread is None:
+                self._repl_thread = threading.Thread(
+                    target=self._replication_loop, daemon=True)
+                self._repl_thread.start()
+        self._repl_wake.set()
+
+    def _replication_loop(self) -> None:
+        from tpusystem.checkpoint.memstore import pack_hot
+        while not self._repl_stop.is_set():
+            self._repl_wake.wait()
+            self._repl_wake.clear()
+            while True:
+                with self._repl_lock:
+                    if not self._repl_pending:
+                        break
+                    identity, entry = self._repl_pending.popitem()
+                try:
+                    self.transport.send_blob(self.buddy,
+                                             f'replica:{identity}',
+                                             pack_hot(entry))
+                except OSError as error:
+                    logger.warning('hot-state replication to buddy %d '
+                                   'failed (%s); local copy and disk still '
+                                   'stand', self.buddy, error)
+
+    def _accept_replica(self, sender: int, key: str, data: bytes) -> None:
+        if not key.startswith('replica:') or self.store is None:
+            return
+        from tpusystem.checkpoint.memstore import unpack_hot
+        identity = key[len('replica:'):]
+        try:
+            entry = unpack_hot(data)
+            self.store.put(identity, entry.step, entry.blob,
+                           extras=entry.extras, digest=entry.digest,
+                           replica=True)
+        except Exception as error:        # torn replica: keep the old copy
+            logger.warning('replica of %r from rank %d rejected (%s)',
+                           identity, sender, error)
+
+    def _serve_replica(self, key: str) -> bytes | None:
+        if not key.startswith('hot:') or self.store is None:
+            return None
+        from tpusystem.checkpoint.memstore import pack_hot
+        entry = self.store.newest(key[4:], replica=True)
+        return None if entry is None else pack_hot(entry)
+
+    def _pull_from_buddy(self, identity: str) -> Any:
+        """A local ``get`` missed (fresh supervisor on a replaced host):
+        pull this identity's hot state back from the buddy's replica slot
+        and cache it locally."""
+        if self.transport is None or self.buddy is None:
+            return None
+        from tpusystem.checkpoint.memstore import unpack_hot
+        try:
+            data = self.transport.fetch_blob(self.buddy, f'hot:{identity}',
+                                             timeout=10.0)
+        except BlobError as error:
+            logger.warning('buddy %d has no usable hot state for %r (%s); '
+                           'disk is the fallback', self.buddy, identity, error)
+            return None
+        entry = unpack_hot(data)
+        return self.store.put(identity, entry.step, entry.blob,
+                              extras=entry.extras, digest=entry.digest)
+
+    # ------------------------------------------------------------------
+    # timeline plumbing (marks arrive on server threads; everything else
+    # runs on the supervising thread)
+
+    def _on_mark(self, stage: str, info: dict) -> None:
+        self._marks.append((stage, dict(info or {}), self._clock()))
+
+    def _drain_marks(self) -> None:
+        while self._marks:
+            stage, info, at = self._marks.popleft()
+            if stage == 'first-step':
+                self._first_step_at = at
+            if stage == 'restore':
+                self._restore_info = info
+            if self._timeline is not None:
+                self._timeline.setdefault(stage, at)
+                if stage == 'first-step':
+                    self._emit_timeline()
+
+    def _emit_timeline(self) -> None:
+        timeline, self._timeline = self._timeline, None
+        detect = timeline.pop('detect')
+        stages = {stage: at - detect for stage, at in timeline.items()}
+        restore = self._restore_info or {}
+        seconds = stages.get('first-step', 0.0)
+        logger.info('recovery complete on rank %d: %.3fs detect->first-step '
+                    '(source=%s, stages=%s)', self.rank, seconds,
+                    restore.get('source'), {k: round(v, 3)
+                                            for k, v in stages.items()})
+        from tpusystem.observe.events import RecoveryTimeline
+        event = RecoveryTimeline(rank=self.rank,
+                                 step=restore.get('step'),
+                                 source=restore.get('source'),
+                                 seconds=seconds, stages=stages)
+        self.timelines.append(event)
+        self._dispatch(event)
+
+    def _dispatch(self, event: Any) -> None:
+        if self.producer is not None:
+            self.producer.dispatch(event)
+
+    # ------------------------------------------------------------------
+    # the control loop
+
+    def terminate(self) -> None:
+        """Begin the preemption drain: forward SIGTERM to the worker, give
+        it ``grace`` seconds to unwind (fence + exit 43), then SIGKILL.
+        Safe from a signal handler or another thread."""
+        self._terminate.set()
+
+    def install_signal_handler(self, *signals: int) -> None:
+        """Arm :meth:`terminate` on the given signals (default SIGTERM).
+        Main thread only — same Python constraint as
+        ``Runtime.install_preemption_handler``."""
+        for signum in signals or (signal_module.SIGTERM,):
+            signal_module.signal(signum, lambda *_: self.terminate())
+
+    def run(self) -> int:
+        """Supervise until the contract says stop; returns the exit code
+        the *supervisor* should end with."""
+        try:
+            return self._supervise()
+        finally:
+            self.close()
+
+    def _supervise(self) -> int:
+        from tpusystem.observe.events import WorkerExited, WorkerRelaunched
+        attempt = 0          # backoff ladder position (reset by progress)
+        rapid = 0            # consecutive crash-loop samples
+        while True:
+            if self._terminate.is_set():
+                # eviction arrived during the backoff sleep: relaunching
+                # now would spawn a worker only to SIGTERM it (likely
+                # before its preemption handler is even installed) — the
+                # last worker already drained/checkpointed, so report the
+                # preemption itself
+                logger.info('rank %d: termination requested before '
+                            'relaunch; exiting %d', self.rank,
+                            PREEMPTED_EXIT)
+                return PREEMPTED_EXIT
+            env = {**os.environ, **self.env}
+            if self.server is not None:
+                env.update(self.server.env)
+            self._first_step_at = None
+            self._restore_info = None
+            launched = self._clock()
+            if self._timeline is not None:
+                self._timeline.setdefault('relaunch', launched)
+            worker = self._popen(self.argv, env=env)
+            logger.info('rank %d: launched worker pid %s', self.rank,
+                        getattr(worker, 'pid', '?'))
+            code = self._wait(worker)
+            self._drain_marks()
+            uptime = self._clock() - launched
+            reason = _describe(code)
+
+            if self._terminate.is_set():
+                # our own eviction: the worker drained (or was killed after
+                # the grace); pass its verdict through, never relaunch. A
+                # signal death has no pass-through-able code — raising
+                # SystemExit(-9) would surface as a meaningless 128+ shell
+                # status — so it maps to the preemption code: the eviction
+                # is the truth of what happened.
+                if code < 0:
+                    logger.warning(
+                        'rank %d: worker died to %s without draining; '
+                        'reporting the eviction as exit %d', self.rank,
+                        reason, PREEMPTED_EXIT)
+                    code = PREEMPTED_EXIT
+                self._dispatch(WorkerExited(rank=self.rank, code=code,
+                                            action='drain', uptime=uptime,
+                                            reason=reason))
+                logger.info('rank %d: preemption drain done (%s)', self.rank,
+                            reason)
+                return code
+            if code == 0:
+                self._dispatch(WorkerExited(rank=self.rank, code=0,
+                                            action='done', uptime=uptime,
+                                            reason=reason))
+                return 0
+            restartable = code in RESTART_EXITS or code < 0
+            if not restartable:
+                action = 'halt'
+                self._dispatch(WorkerExited(rank=self.rank, code=code,
+                                            action=action, uptime=uptime,
+                                            reason=reason))
+                logger.error(
+                    'rank %d: worker exited %d (%s) — not a restart code; '
+                    'halting for triage%s', self.rank, code, reason,
+                    ' (divergence: a blind relaunch would replay it)'
+                    if code == DIVERGED_EXIT else '')
+                return code
+
+            # crash-loop containment: a restartable exit within the window
+            # of first-step (or of launch, if it never got that far) made
+            # no progress; K of those in a row and relaunching is futile
+            anchor = self._first_step_at or launched
+            productive = (self._clock() - anchor) >= self.crash_loop_window
+            rapid = 0 if productive else rapid + 1
+            if productive:
+                attempt = 0
+            if rapid >= self.crash_loop_k or (
+                    self.max_restarts is not None
+                    and self.restarts >= self.max_restarts):
+                self._dispatch(WorkerExited(rank=self.rank, code=code,
+                                            action='crash-loop',
+                                            uptime=uptime, reason=reason))
+                logger.error(
+                    'rank %d: crash loop — %d consecutive restartable exits '
+                    'within %.0fs of first-step; giving up with exit %d',
+                    self.rank, rapid, self.crash_loop_window, CRASH_LOOP_EXIT)
+                return CRASH_LOOP_EXIT
+
+            self._timeline = {'detect': self._clock()}
+            self._dispatch(WorkerExited(rank=self.rank, code=code,
+                                        action='relaunch', uptime=uptime,
+                                        reason=reason))
+            backoff = min(self.backoff_cap, self.backoff_base * 2 ** attempt)
+            backoff *= 1.0 + self.backoff_jitter * self._rng.random()
+            attempt += 1
+            self.restarts += 1
+            logger.warning(
+                'rank %d: worker lost (%s) after %.1fs; relaunch #%d in '
+                '%.2fs', self.rank, reason, uptime, self.restarts, backoff)
+            self._dispatch(WorkerRelaunched(rank=self.rank, attempt=attempt,
+                                            restarts=self.restarts,
+                                            backoff=backoff))
+            self._sleep(backoff)
+
+    def _wait(self, worker: Any) -> int:
+        """Poll the worker to completion, draining timeline marks and
+        reacting to :meth:`terminate` (SIGTERM forward → grace → SIGKILL).
+        Polling — not ``wait()`` — so a signal arriving between frames is
+        honored within ``poll_interval``."""
+        term_sent_at: float | None = None
+        while True:
+            code = worker.poll()
+            if code is not None:
+                return code
+            self._drain_marks()
+            if self._terminate.is_set() and term_sent_at is None:
+                term_sent_at = self._clock()
+                logger.info('rank %d: forwarding SIGTERM to worker '
+                            '(grace %.0fs)', self.rank, self.grace)
+                try:
+                    worker.send_signal(signal_module.SIGTERM)
+                except (OSError, ValueError):
+                    pass
+            elif (term_sent_at is not None
+                    and self._clock() - term_sent_at > self.grace):
+                logger.warning('rank %d: grace expired; SIGKILLing worker',
+                               self.rank)
+                try:
+                    worker.kill()
+                except OSError:
+                    pass
+                term_sent_at = float('inf')   # kill once, keep polling
+            self._sleep(self._poll_interval)
+
+    def close(self) -> None:
+        self._repl_stop.set()
+        self._repl_wake.set()          # unblock the replication worker
+        if self.server is not None:
+            self.server.close()
+
+    def __enter__(self) -> 'Supervisor':
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

@@ -43,6 +43,12 @@ Typical pod-ready epoch loop::
         raise exit_for_restart(reason)         # scheduler restarts -> resume
     finally:
         runtime.close()
+
+The launcher side of that contract — relaunch on 42/43 with backoff,
+crash-loop containment, SIGTERM forwarding into the preemption handler,
+and hot in-memory restores — is :class:`tpusystem.parallel.Supervisor`;
+run the worker under it and the ``raise exit_for_restart(...)`` above is
+answered in seconds.
 """
 
 from __future__ import annotations
