@@ -4,6 +4,9 @@ Prints the ``tp_ffn_overlap_speedup_vs_gspmd`` row first (the
 latency-hiding TP collectives A/B, ``benchmarks/tp_overlap.py headline``
 in a subprocess — virtual-mesh smoke on CPU, real numbers on multi-chip
 TPU; see BASELINE.md "tp_overlap protocol"), then the
+``fsdp_overlap_speedup_vs_gspmd`` row (the unified overlap scheduler's
+FSDP param-prefetch/grad-scatter hiding A/B,
+``benchmarks/fsdp_overlap.py headline``, same protocol), then the
 ``sentinel_overhead`` row (steps/s with the in-graph divergence guard on
 vs off — the < 2% budget tracked in BENCH_*.json from day one), then the
 ``recovery_seconds`` row (hot in-memory restore vs disk restore wall
@@ -64,18 +67,18 @@ def peak_flops(device) -> float | None:
     return None
 
 
-def tp_overlap_row() -> None:
-    """Print the latency-hiding TP collectives row (BASELINE.md
-    "tp_overlap protocol"): ``benchmarks/tp_overlap.py headline`` in a
-    subprocess (it picks the real mesh on multi-chip hardware and
-    re-execs onto the virtual CPU mesh otherwise — smoke numbers there,
-    real numbers on TPU). Printed BEFORE the MFU headline so the
-    driver's parsed last-line metric stays ``gpt2_125m_train_mfu_1chip``.
-    Never fails the headline run: probe errors print a null-value row."""
+def _overlap_probe_row(script_name: str, metric: str) -> None:
+    """Print one latency-hiding A/B row: ``benchmarks/<script> headline``
+    in a subprocess (each script picks the real mesh on multi-chip
+    hardware and re-execs onto the virtual CPU mesh otherwise — smoke
+    numbers there, real numbers on TPU). Printed BEFORE the MFU headline
+    so the driver's parsed last-line metric stays
+    ``gpt2_125m_train_mfu_1chip``. Never fails the headline run: probe
+    errors print a null-value row."""
     import pathlib
     import subprocess
     import sys
-    script = pathlib.Path(__file__).parent / 'benchmarks' / 'tp_overlap.py'
+    script = pathlib.Path(__file__).parent / 'benchmarks' / script_name
     try:
         probe = subprocess.run([sys.executable, str(script), 'headline'],
                                capture_output=True, text=True, timeout=1800)
@@ -87,9 +90,21 @@ def tp_overlap_row() -> None:
         note = (probe.stderr.strip().splitlines() or ['no output'])[-1][:160]
     except (OSError, subprocess.TimeoutExpired) as error:
         note = str(error)[:160]
-    print(json.dumps({'metric': 'tp_ffn_overlap_speedup_vs_gspmd',
-                      'value': None, 'unit': 'x',
+    print(json.dumps({'metric': metric, 'value': None, 'unit': 'x',
                       'note': f'probe failed: {note}'}))
+
+
+def tp_overlap_row() -> None:
+    """The latency-hiding TP collectives row (BASELINE.md "tp_overlap
+    protocol")."""
+    _overlap_probe_row('tp_overlap.py', 'tp_ffn_overlap_speedup_vs_gspmd')
+
+
+def fsdp_overlap_row() -> None:
+    """The FSDP param-prefetch/grad-scatter hiding row (the unified
+    overlap scheduler's second client, `parallel/schedule.py`; BASELINE.md
+    "fsdp_overlap protocol")."""
+    _overlap_probe_row('fsdp_overlap.py', 'fsdp_overlap_speedup_vs_gspmd')
 
 
 BATCH, SEQ = 16, 1024
@@ -302,6 +317,7 @@ def main() -> None:
 
 if __name__ == '__main__':
     tp_overlap_row()
+    fsdp_overlap_row()
     sentinel_overhead_row()
     recovery_seconds_row()
     main()

@@ -114,12 +114,16 @@ class Block(nn.Module):
     # all-gather/reduce-scatter inserted by the partitioner) | 'overlap'
     # (decomposed latency-hiding ring matmuls, parallel/overlap.py)
     tp_chunks: int = 1  # ppermute payload split per overlap ring hop
+    schedule: object = None  # OverlapSchedule composing the TP rings with
+    # FSDP param-prefetch/grad-scatter under one knob
+    # (parallel/schedule.py); None -> built from the legacy
+    # tp_impl=/tp_chunks= pair (fsdp stays on the GSPMD path)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
-        if self.tp_impl not in ('gspmd', 'overlap'):
-            raise ValueError(f'unknown tp_impl {self.tp_impl!r}; '
-                             "expected 'gspmd' or 'overlap'")
+        from tpusystem.parallel.schedule import resolve_schedule
+        schedule = resolve_schedule(self.schedule, self.tp_impl,
+                                    self.tp_chunks)
         dim = hidden.shape[-1]
         normed = nn.LayerNorm(dtype=jnp.float32, name='ln_1')(hidden)
         attended = SelfAttention(self.heads, self.dropout, self.dtype,
@@ -142,27 +146,38 @@ class Block(nn.Module):
                                  sparse_impl=self.moe_sparse_impl,
                                  name='moe')(normed.astype(self.dtype))
         else:
-            from tpusystem.parallel.overlap import (DenseParams,
-                                                    overlap_applicable,
-                                                    tp_ffn)
+            from tpusystem.parallel.overlap import DenseParams
+            from tpusystem.parallel.schedule import (schedule_applicable,
+                                                     scheduled_ffn)
             grown_features = self.mlp_ratio * dim
-            if (self.tp_impl == 'overlap'
-                    and overlap_applicable(self.mesh, normed.shape,
-                                           grown_features)):
-                # decomposed TP collectives: the sequence rows all-gather
-                # INTO the fc matmul and the proj matmul reduce-scatters
-                # them back, each ring hop hidden under the partial
-                # matmuls (parallel/overlap.py). Params are created at
-                # nn.Dense's exact paths, so the knob never changes a
-                # checkpoint; shapes that cannot tile fall through to the
-                # GSPMD Dense path below.
+            # init ALWAYS takes the nn.Dense path below: the legacy
+            # (non-partitionable) threefry generates different bits when
+            # the scanned init program shards the drawn kernels through
+            # the manual region's in_specs, so routing init through the
+            # scheduled branch would silently change the draws on
+            # composed fsdp x model meshes — nn.Dense is the single init
+            # authority, the schedule a pure apply-time knob
+            if (not self.is_initializing()
+                    and schedule_applicable(schedule, self.mesh,
+                                            normed.shape, grown_features)):
+                # the scheduled FFN (parallel/schedule.py): the sequence
+                # rows all-gather INTO the fc matmul and the proj matmul
+                # reduce-scatters them back (decomposed rings when
+                # schedule.tp='overlap'), and with schedule.fsdp=
+                # 'prefetch' the kernels enter still FSDP-sharded — their
+                # gathers issue at FFN entry (the proj kernel's transfer
+                # hides under the fc matmul) and the grad reduce-scatter
+                # is deferred off the backward critical path. Params are
+                # created at nn.Dense's exact paths, so the knob never
+                # changes a checkpoint; shapes that cannot tile fall
+                # through to the GSPMD Dense path below.
                 w_fc, b_fc = DenseParams(grown_features, name='fc')(dim)
                 w_proj, b_proj = DenseParams(dim, name='proj')(grown_features)
-                shrunk = tp_ffn(
+                shrunk = scheduled_ffn(
                     normed.astype(self.dtype),
                     w_fc.astype(self.dtype), b_fc.astype(self.dtype),
                     w_proj.astype(self.dtype), b_proj.astype(self.dtype),
-                    self.mesh, activation=nn.gelu, chunks=self.tp_chunks)
+                    self.mesh, schedule=schedule, activation=nn.gelu)
             else:
                 grown = nn.Dense(self.mlp_ratio * dim, dtype=self.dtype,
                                  name='fc')(normed.astype(self.dtype))
@@ -212,6 +227,7 @@ class BlockSpan(nn.Module):
     # 'gather' | 'scatter' | 'fused' (Pallas grouped gather-matmul)
     tp_impl: str = 'gspmd'  # dense-FFN TP collectives: 'gspmd' | 'overlap'
     tp_chunks: int = 1
+    schedule: object = None  # OverlapSchedule (see Block.schedule)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -219,7 +235,8 @@ class BlockSpan(nn.Module):
                       attn_dropout=self.attn_dropout, decode=self.decode,
                       max_seq=self.max_seq,
                       per_row_decode=self.per_row_decode,
-                      tp_impl=self.tp_impl, tp_chunks=self.tp_chunks)
+                      tp_impl=self.tp_impl, tp_chunks=self.tp_chunks,
+                      schedule=self.schedule)
         if self.moe_experts and self.span % self.moe_every:
             raise ValueError(f'span ({self.span}) must be a multiple of '
                              f'moe_every ({self.moe_every})')
@@ -296,6 +313,12 @@ class GPT2(nn.Module):
     # (decomposed latency-hiding ring matmuls — parallel/overlap.py;
     # needs a mesh with model > 1, falls back per-shape otherwise)
     tp_chunks: int = 1  # ppermute payload split per overlap ring hop
+    schedule: object = None  # parallel.OverlapSchedule: ONE knob composing
+    # the TP rings (tp='overlap') with FSDP param-prefetch/grad-scatter
+    # hiding (fsdp='prefetch') and their shared ppermute chunking; None
+    # keeps the legacy tp_impl=/tp_chunks= behavior (fsdp on GSPMD).
+    # Purely an implementation schedule — param trees and checkpoints are
+    # bitwise knob-invariant
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -333,7 +356,8 @@ class GPT2(nn.Module):
                           attn_dropout=self.attn_dropout,
                           decode=self.decode, max_seq=self.max_seq,
                           per_row_decode=self.per_row_decode,
-                          tp_impl=self.tp_impl, tp_chunks=self.tp_chunks)
+                          tp_impl=self.tp_impl, tp_chunks=self.tp_chunks,
+                          schedule=self.schedule)
             from tpusystem.parallel.mesh import scan_carry_constraint
             constrain = scan_carry_constraint(self.mesh)
             if self.moe_experts:
@@ -412,6 +436,7 @@ class GPT2(nn.Module):
                                   moe_sparse_impl=self.moe_sparse_impl,
                                   tp_impl=self.tp_impl,
                                   tp_chunks=self.tp_chunks,
+                                  schedule=self.schedule,
                                   name=f'h_{index}')
                 result = block(hidden, train)
                 if is_moe:

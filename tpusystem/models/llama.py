@@ -159,12 +159,16 @@ class LlamaBlock(nn.Module):
     per_row_decode: bool = False
     tp_impl: str = 'gspmd'  # SwiGLU TP collectives: 'gspmd' | 'overlap'
     tp_chunks: int = 1
+    schedule: object = None  # parallel.OverlapSchedule composing TP rings
+    # with FSDP prefetch (see gpt2.Block.schedule); None -> legacy knobs
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
-        if self.tp_impl not in ('gspmd', 'overlap'):
-            raise ValueError(f'unknown tp_impl {self.tp_impl!r}; '
-                             "expected 'gspmd' or 'overlap'")
+        from tpusystem.parallel.schedule import (resolve_schedule,
+                                                 schedule_applicable,
+                                                 scheduled_swiglu)
+        schedule = resolve_schedule(self.schedule, self.tp_impl,
+                                    self.tp_chunks)
         dim = hidden.shape[-1]
         normed = RMSNorm(name='attn_norm')(hidden)
         hidden = hidden + LlamaAttention(
@@ -173,27 +177,33 @@ class LlamaBlock(nn.Module):
             max_seq=self.max_seq, per_row_decode=self.per_row_decode,
             name='attn')(normed, train)
         normed = RMSNorm(name='ffn_norm')(hidden)
-        from tpusystem.parallel.overlap import (DenseParams,
-                                                overlap_applicable,
-                                                tp_swiglu)
-        if (self.tp_impl == 'overlap'
-                and overlap_applicable(self.mesh, normed.shape,
-                                       self.ffn_dim)):
-            # decomposed TP collectives (parallel/overlap.py): one ring
-            # all-gathers the sequence rows into the fused gate|up matmul,
-            # the down matmul reduce-scatters them back, transfers hidden
-            # under the partial matmuls. Same param paths as nn.Dense, so
-            # the knob never changes a checkpoint; non-tiling shapes fall
-            # through to the GSPMD path below.
+        from tpusystem.parallel.overlap import DenseParams
+        # init ALWAYS takes the nn.Dense path below (see gpt2.Block: the
+        # legacy threefry's draws depend on the sharding the manual
+        # region imposes inside a scanned init program — nn.Dense is the
+        # single init authority, the schedule a pure apply-time knob)
+        if (not self.is_initializing()
+                and schedule_applicable(schedule, self.mesh, normed.shape,
+                                        self.ffn_dim)):
+            # the scheduled SwiGLU (parallel/schedule.py): one ring
+            # all-gathers the sequence rows into the fused gate|up matmul
+            # and the down matmul reduce-scatters them back (decomposed
+            # when schedule.tp='overlap'), and with schedule.fsdp=
+            # 'prefetch' the three kernels enter still FSDP-sharded —
+            # gathered at FFN entry so the transfers hide under the
+            # upstream matmuls, grads reduce-scattered off the backward
+            # critical path. Same param paths as nn.Dense, so the knob
+            # never changes a checkpoint; non-tiling shapes fall through
+            # to the GSPMD path below.
             w_gate, _ = DenseParams(self.ffn_dim, use_bias=False,
                                     name='gate')(dim)
             w_up, _ = DenseParams(self.ffn_dim, use_bias=False,
                                   name='up')(dim)
             w_down, _ = DenseParams(dim, use_bias=False,
                                     name='down')(self.ffn_dim)
-            return hidden + tp_swiglu(
+            return hidden + scheduled_swiglu(
                 normed, w_gate.astype(self.dtype), w_up.astype(self.dtype),
-                w_down.astype(self.dtype), self.mesh, chunks=self.tp_chunks)
+                w_down.astype(self.dtype), self.mesh, schedule=schedule)
         dense = lambda features, name: nn.Dense(
             features, use_bias=False, dtype=self.dtype, name=name)
         gated = nn.silu(dense(self.ffn_dim, 'gate')(normed)) \
@@ -222,6 +232,7 @@ class LlamaBlockSpan(nn.Module):
     per_row_decode: bool = False
     tp_impl: str = 'gspmd'
     tp_chunks: int = 1
+    schedule: object = None  # OverlapSchedule (see LlamaBlock.schedule)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -233,6 +244,7 @@ class LlamaBlockSpan(nn.Module):
                                 per_row_decode=self.per_row_decode,
                                 tp_impl=self.tp_impl,
                                 tp_chunks=self.tp_chunks,
+                                schedule=self.schedule,
                                 name=f'd_{index}')(hidden, train)
         return hidden
 
@@ -278,6 +290,10 @@ class Llama(nn.Module):
     # (decomposed latency-hiding ring matmuls — parallel/overlap.py;
     # needs a mesh with model > 1, falls back per-shape otherwise)
     tp_chunks: int = 1  # ppermute payload split per overlap ring hop
+    schedule: object = None  # parallel.OverlapSchedule: ONE knob composing
+    # the TP rings with FSDP param-prefetch/grad-scatter hiding (see
+    # gpt2.GPT2.schedule); None keeps the legacy tp_impl=/tp_chunks=
+    # behavior. Param trees and checkpoints are bitwise knob-invariant
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -310,6 +326,7 @@ class Llama(nn.Module):
                                     per_row_decode=self.per_row_decode,
                                     tp_impl=self.tp_impl,
                                     tp_chunks=self.tp_chunks,
+                                    schedule=self.schedule,
                                     name='blocks')
                 length = self.layers // self.scan_unit
             else:
@@ -322,6 +339,7 @@ class Llama(nn.Module):
                                      per_row_decode=self.per_row_decode,
                                      tp_impl=self.tp_impl,
                                      tp_chunks=self.tp_chunks,
+                                     schedule=self.schedule,
                                      name='blocks')
                 length = self.layers
             from tpusystem.parallel.mesh import scan_carry_constraint
@@ -342,6 +360,7 @@ class Llama(nn.Module):
                                    per_row_decode=self.per_row_decode,
                                    tp_impl=self.tp_impl,
                                    tp_chunks=self.tp_chunks,
+                                   schedule=self.schedule,
                                    name=f'layer_{index}')(hidden, train)
         hidden = RMSNorm(name='final_norm')(hidden)
         # untied head (Llama-3 convention). bf16 x bf16 operands at MXU

@@ -11,12 +11,16 @@ from tpusystem.parallel.multihost import (
 )
 from tpusystem.parallel.collectives import (
     all_gather, all_reduce_mean, all_reduce_sum, all_to_all, axis_index,
-    axis_size, reduce_scatter, replica_checksums, ring_shift,
-    ring_shift_chunked,
+    axis_size, reduce_scatter, replica_checksums, ring_allgather,
+    ring_reducescatter, ring_shift, ring_shift_chunked,
 )
 from tpusystem.parallel.overlap import (
     allgather_matmul, allgather_plan, matmul_reducescatter,
     reducescatter_plan, tp_ffn, tp_swiglu,
+)
+from tpusystem.parallel.schedule import (
+    FsdpPlan, OverlapSchedule, fsdp_plan, resolve_schedule,
+    schedule_applicable, scheduled_ffn, scheduled_swiglu,
 )
 from tpusystem.parallel.pipeline import (PipelineParallel,
                                          compose_stacked_rules,
@@ -57,4 +61,7 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'ring_shift_chunked', 'axis_index', 'axis_size',
            'replica_checksums',
            'allgather_matmul', 'matmul_reducescatter',
-           'allgather_plan', 'reducescatter_plan', 'tp_ffn', 'tp_swiglu']
+           'allgather_plan', 'reducescatter_plan', 'tp_ffn', 'tp_swiglu',
+           'ring_allgather', 'ring_reducescatter',
+           'OverlapSchedule', 'FsdpPlan', 'fsdp_plan', 'resolve_schedule',
+           'schedule_applicable', 'scheduled_ffn', 'scheduled_swiglu']

@@ -87,6 +87,77 @@ def ring_shift_chunked(value, axis: str, *, chunks: int = 1,
     return jnp.concatenate(shifted, axis=0)
 
 
+def ring_allgather(value, axis: str, *, dimension: int = 0,
+                   chunks: int = 1):
+    """:func:`all_gather` decomposed into ``axis_size`` ring steps.
+
+    Each device's shard rotates forward one hop per step
+    (:func:`ring_shift_chunked`, the shared neighbor convention: after
+    ``s`` forward shifts rank ``i`` holds the shard of rank
+    ``(i - s) % n``) and is copied into its row-block of the full
+    ``[..., n * shard, ...]`` result along ``dimension``. The next hop's
+    ``ppermute`` is issued *before* the current block's copy — the
+    latency-hiding order every ring in this repo uses — so XLA's
+    scheduler can hide the transfers under whatever compute consumes the
+    early blocks. The FSDP prefetch path
+    (:mod:`tpusystem.parallel.schedule`) builds its parameter gather on
+    this; semantically identical to one monolithic ``lax.all_gather``.
+    Requires ``value.shape[0] % chunks == 0`` (callers plan around this;
+    see ``schedule.fsdp_plan``).
+    """
+    ring = _axis_size(axis)
+    rank = lax.axis_index(axis)
+    rows = value.shape[dimension]
+    shape = list(value.shape)
+    shape[dimension] = ring * rows
+    out = jnp.zeros(shape, value.dtype)
+    held = value
+    incoming = ring_shift_chunked(held, axis, chunks=chunks)
+    for step in range(ring):
+        if step:
+            held = incoming
+            if step + 1 < ring:
+                incoming = ring_shift_chunked(held, axis, chunks=chunks)
+        source = (rank - step) % ring
+        start = [0] * len(shape)
+        start[dimension] = source * rows
+        out = lax.dynamic_update_slice(out, held, tuple(start))
+    return out
+
+
+def ring_reducescatter(value, axis: str, *, dimension: int = 0,
+                       chunks: int = 1):
+    """:func:`reduce_scatter` decomposed into ``axis_size`` ring steps.
+
+    The dual of :func:`ring_allgather`: at step ``t`` every device takes
+    block ``(rank - 1 - t) % n`` of its full-size ``value`` along
+    ``dimension`` and folds it into the running **float32** sum arriving
+    from its predecessor; the sum's forward shift is issued *before* the
+    next block's add, so after ``n`` steps block ``rank`` lands home
+    carrying all ``n`` contributions with the transfers hidden under the
+    compute that produced the later blocks. Semantically identical to
+    ``lax.psum_scatter(..., tiled=True)`` up to f32 summation order;
+    result is cast back to ``value.dtype``. The FSDP prefetch path uses
+    this as the gradient scatter (the transpose of the parameter gather).
+    """
+    ring = _axis_size(axis)
+    rank = lax.axis_index(axis)
+    rows = value.shape[dimension] // ring
+    sizes = list(value.shape)
+    sizes[dimension] = rows
+
+    def block(step):
+        start = [0] * len(sizes)
+        start[dimension] = ((rank - 1 - step) % ring) * rows
+        return lax.dynamic_slice(value, tuple(start), tuple(sizes))
+
+    total = block(0).astype(jnp.float32)
+    for step in range(1, ring):
+        inflight = ring_shift_chunked(total, axis, chunks=chunks)
+        total = inflight + block(step)
+    return total.astype(value.dtype)
+
+
 def axis_index(axis: str):
     return lax.axis_index(axis)
 

@@ -56,8 +56,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from tpusystem.parallel.collectives import ring_shift_chunked
-from tpusystem.parallel.mesh import (DATA, FSDP, MODEL, SEQ, axis_size,
-                                     shard_map)
+from tpusystem.parallel.mesh import DATA, FSDP, MODEL, SEQ, axis_size
 
 
 class OverlapPlan(NamedTuple):
@@ -377,23 +376,17 @@ def tp_ffn(x, kernel_up, bias_up, kernel_down, bias_down, mesh, *,
     gathered rows, and the down matmul reduce-scatters rows back —
     both collectives overlapped. Output is ``[batch, seq, dim]`` sharded
     like the input.
-    """
-    @functools.partial(
-        shard_map, mesh=mesh, check_vma=False,
-        in_specs=(_row_specs(mesh, x.shape[0], axis), P(None, axis),
-                  P(axis), P(axis, None), P(None)),
-        out_specs=_row_specs(mesh, x.shape[0], axis))
-    def mapped(x, w_up, b_up, w_down, b_down):
-        batch, seq, dim = x.shape
-        rows = x.reshape(batch * seq, dim)
-        grown = allgather_matmul(rows, w_up, axis, chunks=chunks)
-        grown = activation(grown + b_up)
-        out = matmul_reducescatter(grown, w_down, axis, chunks=chunks)
-        # bias lands after the scatter so the sum counts it exactly once
-        out = out + b_down
-        return out.reshape(batch, seq, dim)
 
-    return mapped(x, kernel_up, bias_up, kernel_down, bias_down)
+    Since the unified scheduler landed this is the TP-only special case
+    of :func:`tpusystem.parallel.schedule.scheduled_ffn` (kept as the
+    stable two-knob API; the delegation is exact — same specs, same
+    body, same numerics).
+    """
+    from tpusystem.parallel.schedule import OverlapSchedule, scheduled_ffn
+    return scheduled_ffn(x, kernel_up, bias_up, kernel_down, bias_down,
+                         mesh, schedule=OverlapSchedule(tp='overlap',
+                                                        chunks=chunks),
+                         activation=activation, axis=axis)
 
 
 def tp_swiglu(x, kernel_gate, kernel_up, kernel_down, mesh, *,
@@ -404,22 +397,13 @@ def tp_swiglu(x, kernel_gate, kernel_up, kernel_down, mesh, *,
     concatenate into a single ``[dim, 2 * grown]`` right operand, so the
     sequence rows ride the ring ONCE for both matmuls. No biases (Llama
     convention).
-    """
-    @functools.partial(
-        shard_map, mesh=mesh, check_vma=False,
-        in_specs=(_row_specs(mesh, x.shape[0], axis), P(None, axis),
-                  P(None, axis), P(axis, None)),
-        out_specs=_row_specs(mesh, x.shape[0], axis))
-    def mapped(x, w_gate, w_up, w_down):
-        batch, seq, dim = x.shape
-        rows = x.reshape(batch * seq, dim)
-        fused = jnp.concatenate([w_gate, w_up], axis=1)
-        grown = allgather_matmul(rows, fused, axis, chunks=chunks)
-        gate, up = jnp.split(grown, 2, axis=1)
-        # jax.nn.silu IS flax's nn.silu (a re-export) — identical numerics
-        # to the GSPMD Dense path
-        hidden = jax.nn.silu(gate) * up
-        out = matmul_reducescatter(hidden, w_down, axis, chunks=chunks)
-        return out.reshape(batch, seq, dim)
 
-    return mapped(x, kernel_gate, kernel_up, kernel_down)
+    Since the unified scheduler landed this is the TP-only special case
+    of :func:`tpusystem.parallel.schedule.scheduled_swiglu` (kept as the
+    stable two-knob API; the delegation is exact).
+    """
+    from tpusystem.parallel.schedule import OverlapSchedule, scheduled_swiglu
+    return scheduled_swiglu(x, kernel_gate, kernel_up, kernel_down, mesh,
+                            schedule=OverlapSchedule(tp='overlap',
+                                                     chunks=chunks),
+                            axis=axis)

@@ -89,9 +89,32 @@ def _mesh_axis_size(mesh: Mesh, axis) -> int:
     return mesh.shape[axis]
 
 
+def fsdp_shard_dim(shape: tuple[int, ...], fsdp_size: int,
+                   taken: Sequence[int] = ()) -> int | None:
+    """Which dimension FSDP inference shards — the single source of truth.
+
+    The **largest** dimension not in ``taken`` (indices already claimed by
+    rule axes) whose size divides ``fsdp_size``; ``None`` when no dimension
+    qualifies. Ties are broken **deterministically: the lowest index
+    wins** — the choice is pinned here (and regression-tested) so a param
+    tree can never silently reshard across jax/python versions from an
+    enumeration-order change, which would invalidate every checkpoint
+    placed under the old choice. The overlap scheduler's
+    :func:`tpusystem.parallel.schedule.fsdp_plan` consults this same
+    function, so the manual prefetch collectives always agree with the
+    placement the policy chose.
+    """
+    candidates = [index for index in range(len(shape))
+                  if index not in taken and shape[index] % fsdp_size == 0]
+    if not candidates:
+        return None
+    return min(candidates, key=lambda index: (-shape[index], index))
+
+
 def _with_fsdp(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh,
                min_size: int) -> PartitionSpec:
-    """Add the fsdp axis to the largest unsharded, divisible dimension."""
+    """Add the fsdp axis to the largest unsharded, divisible dimension
+    (ties: lowest index — see :func:`fsdp_shard_dim`)."""
     fsdp_size = mesh.shape[FSDP]
     if fsdp_size == 1:
         return spec
@@ -101,11 +124,10 @@ def _with_fsdp(spec: PartitionSpec, shape: tuple[int, ...], mesh: Mesh,
     if any(axis == FSDP or (isinstance(axis, tuple) and FSDP in axis)
            for axis in entries):
         return spec
-    candidates = [index for index, axis in enumerate(entries)
-                  if axis is None and shape[index] % fsdp_size == 0]
-    if not candidates:
+    taken = [index for index, axis in enumerate(entries) if axis is not None]
+    best = fsdp_shard_dim(tuple(shape), fsdp_size, taken)
+    if best is None:
         return spec
-    best = max(candidates, key=lambda index: shape[index])
     entries[best] = FSDP
     while entries and entries[-1] is None:
         entries.pop()
