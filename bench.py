@@ -11,8 +11,11 @@ FSDP param-prefetch/grad-scatter hiding A/B,
 vs off — the < 2% budget tracked in BENCH_*.json from day one), then the
 ``recovery_seconds`` row (hot in-memory restore vs disk restore wall
 time on the tiny model — the per-recovery saving the Supervisor's
-memstore tier buys), then the headline as the LAST JSON line (the one
-the driver parses):
+memstore tier buys), then the ``decode_tok_s``/``decode_stream_bytes``
+rows (serving-path greedy decode throughput at the BASELINE decode
+config plus the per-step streamed weight bytes auto-vs-int8 — the
+roofline lever, ``benchmarks/decode_roofline.py``), then the headline
+as the LAST JSON line (the one the driver parses):
 ``{"metric": ..., "value": N, "spread": N, "unit": ..., "vs_baseline": N}``.
 
 ``value`` is the **median of TRIALS (>= 3) timed runs** after a shared
@@ -266,6 +269,60 @@ def recovery_seconds_row() -> None:
                           'note': f'probe failed: {str(error)[:160]}'}))
 
 
+def decode_rows() -> None:
+    """Print the serving-path decode rows: ``decode_tok_s`` (greedy
+    generate at the BASELINE decode config — GPT-2 125M, batch 8,
+    prefill 128, decode 128, ``stream_dtype='auto'``) and
+    ``decode_stream_bytes`` (per-step streamed weight bytes of that
+    tree, with the int8-quantized tree's bytes alongside — the
+    roofline lever, ``benchmarks/decode_roofline.py``). Printed BEFORE
+    the MFU headline so the driver's parsed last-line metric is
+    unchanged; never fails the run (probe errors print null rows)."""
+    try:
+        from tpusystem.models import GPT2
+        from tpusystem.train.generate import generate, streamed_bytes
+
+        batch, prefill, decode = 8, 128, 128
+        module = GPT2(dropout=0.0, vocab_size=50304, max_seq=512)
+        prompt = jnp.asarray(
+            np.random.default_rng(0).integers(0, 50257, (batch, prefill)),
+            jnp.int32)
+        params = module.init(jax.random.PRNGKey(0),
+                             prompt[:1, :8])['params']
+
+        out = generate(module, params, prompt, steps=decode)   # warm/compile
+        materialize(out)
+        elapsed_trials = []
+        for _ in range(TRIALS):
+            start = time.perf_counter()
+            out = generate(module, params, prompt, steps=decode)
+            materialize(out)
+            elapsed_trials.append(time.perf_counter() - start)
+        elapsed = sorted(elapsed_trials)[len(elapsed_trials) // 2]
+        to_tok = lambda secs: batch * decode / secs
+        print(json.dumps({
+            'metric': 'decode_tok_s',
+            'value': round(to_tok(elapsed)),
+            'spread': round(to_tok(min(elapsed_trials))
+                            - to_tok(max(elapsed_trials))),
+            'unit': 'tok/s (125M, batch 8, prefill 128, decode 128)',
+        }))
+        auto_bytes = streamed_bytes(module, params, 'auto')
+        int8_bytes = streamed_bytes(module, params, 'int8')
+        print(json.dumps({
+            'metric': 'decode_stream_bytes',
+            'value': auto_bytes,
+            'unit': 'bytes/step (streamed param tree, stream_dtype=auto)',
+            'int8_bytes': int8_bytes,
+            'int8_reduction': round(auto_bytes / int8_bytes, 2),
+        }))
+    except Exception as error:  # never cost the headline its run
+        for metric, unit in (('decode_tok_s', 'tok/s'),
+                             ('decode_stream_bytes', 'bytes/step')):
+            print(json.dumps({'metric': metric, 'value': None, 'unit': unit,
+                              'note': f'probe failed: {str(error)[:160]}'}))
+
+
 def main() -> None:
     from tpusystem.train import (ChunkedNextTokenLoss, build_train_step,
                                  flax_apply, init_state)
@@ -320,4 +377,5 @@ if __name__ == '__main__':
     fsdp_overlap_row()
     sentinel_overhead_row()
     recovery_seconds_row()
+    decode_rows()
     main()

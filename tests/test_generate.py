@@ -310,6 +310,183 @@ def test_stream_dtype_auto_matches_f32_streaming_exactly():
     np.testing.assert_array_equal(np.asarray(auto), np.asarray(f32))
 
 
+def test_stream_dtype_bfloat16_matches_auto_token_exact(prompt):
+    """'bfloat16' on a bf16-compute model is the identical program to
+    'auto' (both pre-cast the f32 matrix masters to bf16) — token-exact;
+    on an f32-compute model it bf16-rounds the weights but still decodes
+    in-vocab tokens."""
+    module = gpt2_tiny(dtype='bfloat16')
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    auto = generate(module, params, prompt, steps=10)
+    forced = generate(module, params, prompt, steps=10,
+                      stream_dtype='bfloat16')
+    np.testing.assert_array_equal(np.asarray(auto), np.asarray(forced))
+
+    f32_module = gpt2_tiny(dtype='float32')
+    f32_params = f32_module.init(jax.random.PRNGKey(0), prompt)['params']
+    out = np.asarray(generate(f32_module, f32_params, prompt, steps=6,
+                              stream_dtype='bfloat16'))
+    assert ((out >= 0) & (out < f32_module.vocab_size)).all()
+
+
+def test_stream_dtype_unknown_raises_enumerating_the_valid_set(prompt):
+    module = gpt2_tiny(dtype='float32')
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    with pytest.raises(ValueError) as excinfo:
+        generate(module, params, prompt, steps=2, stream_dtype='int4')
+    for mode in ('auto', 'float32', 'bfloat16', 'int8', 'fp8'):
+        assert mode in str(excinfo.value)
+
+
+def test_quantizer_cache_reuses_compiled_program(prompt):
+    """The caster-cache regression pin, quantize flavored: _quantizer must
+    be one cached jitted program per mode — an uncached jit would retrace
+    the whole-tree quantization every generate() call (the round-5 8x
+    decode slowdown)."""
+    import importlib
+    generate_module = importlib.import_module('tpusystem.train.generate')
+    module = gpt2_tiny(dtype='float32')
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    generate(module, params, prompt, steps=2, stream_dtype='int8')
+    before = generate_module._quantizer.cache_info().hits
+    generate(module, params, prompt, steps=2, stream_dtype='int8')
+    assert generate_module._quantizer.cache_info().hits == before + 1
+
+
+def test_int8_streaming_bounded_logit_divergence_and_finite_decode(prompt):
+    """int8 weight streaming is lossy but bounded: the dequantized tree's
+    logits stay within a small absolute band of the master tree's, and
+    greedy decode emits finite in-vocab tokens."""
+    from tpusystem.ops.precision import dequantize_streamed, quantize_streamed
+    module = gpt2_tiny(dtype='float32')
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    exact = module.apply({'params': params}, prompt)
+    quantized = dequantize_streamed(quantize_streamed(params, 'int8'))
+    approximate = module.apply({'params': quantized}, prompt)
+    divergence = float(jnp.max(jnp.abs(exact - approximate)))
+    assert np.isfinite(np.asarray(approximate)).all()
+    assert 0.0 < divergence < 0.5, divergence   # lossy, but bounded
+
+    out = np.asarray(generate(module, params, prompt, steps=8,
+                              stream_dtype='int8'))
+    assert ((out >= 0) & (out < module.vocab_size)).all()
+
+
+def test_fp8_streaming_bounded_divergence_or_clear_gate(prompt):
+    """Where the jaxlib supports float8_e4m3fn the fp8 stream decodes
+    finite in-vocab tokens with bounded logit divergence; elsewhere the
+    capability probe's reason surfaces in the ValueError."""
+    from tpusystem.ops.precision import (dequantize_streamed,
+                                         fp8_unsupported_reason,
+                                         quantize_streamed)
+    module = gpt2_tiny(dtype='float32')
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    reason = fp8_unsupported_reason()
+    if reason is not None:
+        with pytest.raises(ValueError, match='fp8'):
+            generate(module, params, prompt, steps=2, stream_dtype='fp8')
+        return
+    exact = module.apply({'params': params}, prompt)
+    quantized = dequantize_streamed(quantize_streamed(params, 'fp8'))
+    approximate = module.apply({'params': quantized}, prompt)
+    assert float(jnp.max(jnp.abs(exact - approximate))) < 0.5
+    out = np.asarray(generate(module, params, prompt, steps=6,
+                              stream_dtype='fp8'))
+    assert ((out >= 0) & (out < module.vocab_size)).all()
+
+
+@pytest.mark.slow
+def test_batched_speculative_matches_batch1_trajectories_row_wise():
+    """The batched verify forward amortizes one weight pass across the
+    whole batch; per-row acceptance bookkeeping must reproduce each
+    row's batch-1 trajectory exactly — a batch of prompts decodes to the
+    same tokens as each prompt alone."""
+    from tpusystem.train import speculative_generate
+    target = gpt2_tiny(dtype='float32', max_seq=128)
+    draft = gpt2_tiny(dtype='float32', layers=1, dim=32, heads=2,
+                      max_seq=128)
+    prompts = jnp.asarray(
+        np.random.default_rng(31).integers(0, 256, (3, 8)), jnp.int32)
+    params = target.init(jax.random.PRNGKey(0), prompts)['params']
+    draft_params = draft.init(jax.random.PRNGKey(9), prompts)['params']
+    batched = np.asarray(speculative_generate(
+        target, params, prompts, steps=16, draft_module=draft,
+        draft_params=draft_params, speculate=3))
+    for row in range(prompts.shape[0]):
+        alone = np.asarray(speculative_generate(
+            target, params, prompts[row:row + 1], steps=16,
+            draft_module=draft, draft_params=draft_params, speculate=3))
+        np.testing.assert_array_equal(batched[row:row + 1], alone,
+                                      err_msg=f'row {row}')
+
+
+@pytest.mark.slow
+def test_tree_speculative_verify_equals_greedy():
+    """Token-tree verify (tree_fanout=F): F draft branches per sequence
+    verified as extra batch rows in one target forward — output must
+    still be EXACTLY the target's greedy decode, for any fanout and any
+    draft quality (including the full-acceptance self-draft)."""
+    from tpusystem.train import generate, speculative_generate
+    target = gpt2_tiny(dtype='float32', max_seq=128)
+    draft = gpt2_tiny(dtype='float32', layers=1, dim=32, heads=2,
+                      max_seq=128)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (2, 8)), jnp.int32)
+    params = target.init(jax.random.PRNGKey(0), tokens)['params']
+    draft_params = draft.init(jax.random.PRNGKey(9), tokens)['params']
+    reference = np.asarray(generate(target, params, tokens, steps=20))
+    for fanout in (2, 3):
+        out = speculative_generate(
+            target, params, tokens, steps=20, draft_module=draft,
+            draft_params=draft_params, speculate=3, tree_fanout=fanout)
+        np.testing.assert_array_equal(np.asarray(out), reference,
+                                      err_msg=f'fanout {fanout}')
+    out = speculative_generate(
+        target, params, tokens, steps=20, draft_module=target,
+        draft_params=params, speculate=4, tree_fanout=2)
+    np.testing.assert_array_equal(np.asarray(out), reference)
+
+
+def test_tree_speculative_validates_args():
+    from tpusystem.train import speculative_generate
+    target = gpt2_tiny(dtype='float32', max_seq=64)
+    tokens = jnp.zeros((1, 4), jnp.int32)
+    params = target.init(jax.random.PRNGKey(0), tokens)['params']
+    with pytest.raises(ValueError, match='tree_fanout'):
+        speculative_generate(target, params, tokens, steps=4,
+                             draft_module=target, draft_params=params,
+                             speculate=2, tree_fanout=0)
+    with pytest.raises(ValueError, match='greedy'):
+        speculative_generate(target, params, tokens, steps=4,
+                             draft_module=target, draft_params=params,
+                             speculate=2, tree_fanout=2, temperature=1.0,
+                             rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match='vocab'):
+        speculative_generate(target, params, tokens, steps=4,
+                             draft_module=target, draft_params=params,
+                             speculate=2, tree_fanout=1000)
+
+
+@pytest.mark.slow
+def test_speculative_quantized_streaming_decodes_in_vocab():
+    """stream_dtype='int8' applies to BOTH trees of the speculative path
+    (the verify forward streams narrow bytes too) — output stays
+    finite/in-vocab with per-row bookkeeping intact."""
+    from tpusystem.train import speculative_generate
+    target = gpt2_tiny(dtype='float32', max_seq=128)
+    draft = gpt2_tiny(dtype='float32', layers=1, dim=32, heads=2,
+                      max_seq=128)
+    tokens = jnp.asarray(
+        np.random.default_rng(13).integers(0, 256, (2, 8)), jnp.int32)
+    params = target.init(jax.random.PRNGKey(0), tokens)['params']
+    draft_params = draft.init(jax.random.PRNGKey(9), tokens)['params']
+    out = np.asarray(speculative_generate(
+        target, params, tokens, steps=12, draft_module=draft,
+        draft_params=draft_params, speculate=3, stream_dtype='int8'))
+    assert ((out >= 0) & (out < target.vocab_size)).all()
+    np.testing.assert_array_equal(out[:, :8], np.asarray(tokens))
+
+
 @pytest.mark.slow
 def test_bucketed_cache_attention_crosses_bucket_boundary():
     """max_seq 512 decode buckets cache reads at [256, 512]; a generation

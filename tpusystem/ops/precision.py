@@ -1,13 +1,30 @@
-"""Mixed-precision matmul helpers shared by every LM head.
+"""Mixed-precision matmul helpers shared by every LM head — and the
+quantized weight-streaming pair behind the serving-path decode.
 
-One rule, applied everywhere a head projects features onto a vocabulary:
-operands in the compute dtype (bf16 — MXU rate), accumulation and result in
-float32 (loss-stable softmax). Centralized so the GPT-2 tied head, the
-pipelined variant, the Llama untied head, and the fused chunked loss stay
-numerically in lockstep.
+Two rule sets live here:
+
+* **Head precision** (training): operands in the compute dtype (bf16 —
+  MXU rate), accumulation and result in float32 (loss-stable softmax).
+  Centralized so the GPT-2 tied head, the pipelined variant, the Llama
+  untied head, and the fused chunked loss stay numerically in lockstep.
+
+* **Streamed quantization** (decode): small-batch decode is weight-
+  STREAMING bound (benchmarks/decode_roofline.py), so the lever is HBM
+  bytes per step. :func:`quantize_streamed` rounds the decoder's matrix
+  params to int8/fp8 with **per-output-channel symmetric scales**
+  computed once at stream time; :func:`qdot` is the matching matmul —
+  the scale is a per-column constant, so it factors out of the
+  contraction exactly (``x @ (q * s) == (x @ q) * s``) and is applied
+  once to the f32 accumulator, never to the streamed tiles. Vector
+  leaves (biases, layernorms), embedding tables, and MoE routers stay
+  untouched — the same exclusion rule the decode caster applies
+  (``tpusystem.train.generate``), for the same reasons.
 """
 
 from __future__ import annotations
+
+import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -45,3 +62,188 @@ def head_logits(features, table, *, tied: bool | None = None) -> jax.Array:
     features = features.astype(table.dtype)
     return f32_accum_dot(
         features, table, (((features.ndim - 1,), (table_dim,)), ((), ())))
+
+
+# --- streamed quantization (serving-path decode) -------------------------
+
+# symmetric range per streamable narrow dtype: int8 uses the full signed
+# range minus the asymmetric -128 (so negation is exact), fp8 e4m3fn its
+# largest finite (the cast saturates NaN-ward past it, hence the clip in
+# the quantizer)
+QMAX = {'int8': 127.0, 'fp8': 448.0}
+
+
+def _qdtype(mode: str):
+    if mode == 'int8':
+        return jnp.dtype(jnp.int8)
+    if mode == 'fp8':
+        return jnp.dtype(jnp.float8_e4m3fn)
+    raise ValueError(f'unknown quantized stream mode {mode!r}; '
+                     f"expected one of {tuple(QMAX)}")
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QuantizedLeaf:
+    """A matrix param streamed narrow: ``values`` (int8/fp8, the original
+    leaf's shape) plus per-output-channel f32 ``scales`` (the leaf's
+    shape with the contraction dim — second-to-last — reduced to 1), so
+    ``values * scales`` broadcasts back to the dequantized matrix. A
+    registered pytree node: quantized param trees pass through ``jit``
+    boundaries and ``tree_map`` like plain trees."""
+
+    values: jax.Array
+    scales: jax.Array
+
+    def tree_flatten(self):
+        return (self.values, self.scales), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.values.shape
+
+    @property
+    def nbytes(self) -> int:
+        return self.values.nbytes + self.scales.nbytes
+
+
+def quantize_leaf(leaf, mode: str) -> QuantizedLeaf:
+    """Per-output-channel symmetric quantization of one ``[..., in, out]``
+    matrix: ``scales = absmax(leaf, axis=-2) / QMAX``, values rounded
+    (int8) or cast (fp8) after clipping into the representable range.
+    All-zero columns get scale 1 so the dequant stays finite."""
+    qdtype = _qdtype(mode)
+    qmax = QMAX[mode]
+    wide = leaf.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wide), axis=-2, keepdims=True)
+    scales = jnp.where(absmax > 0.0, absmax, qmax) / qmax
+    scaled = jnp.clip(wide / scales, -qmax, qmax)
+    if mode == 'int8':
+        scaled = jnp.round(scaled)
+    return QuantizedLeaf(scaled.astype(qdtype), scales)
+
+
+def dequantize_leaf(leaf: QuantizedLeaf, compute=None) -> jax.Array:
+    """``values * scales`` in f32, rounded once to ``compute`` (default:
+    float32) — what the non-fused decode path feeds the model per step."""
+    wide = leaf.values.astype(jnp.float32) * leaf.scales
+    return wide if compute is None else wide.astype(compute)
+
+
+def _is_quantized(node) -> bool:
+    return isinstance(node, QuantizedLeaf)
+
+
+def quantize_streamed(params, mode: str):
+    """Quantize a param tree's streamed matrices to ``mode``
+    (``'int8'``/``'fp8'``), leaving every other leaf untouched.
+
+    The leaf rule is exactly the decode caster's
+    (:func:`tpusystem.train.generate._caster`): float matrices with
+    ``ndim >= 2``, excluding embedding tables (the embed step adds
+    wte+wpe rows in f32; for a tied head the table must stay exact) and
+    MoE routers (f32 gate logits — a quantized router could flip
+    near-tie expert choices). Biases and layernorm params are vectors
+    and fall through unchanged. Jit this once per mode
+    (``generate``'s ``_quantizer`` cache) — an uncached quantize would
+    retrace per call, the round-5 trap."""
+    qdtype = _qdtype(mode)   # validates the mode eagerly
+    del qdtype
+
+    def quantize(path, leaf):
+        from tpusystem.parallel.sharding import leaf_path
+        path = leaf_path(path)
+        if 'embedding' in path or 'router' in path:
+            return leaf
+        if leaf.ndim >= 2 and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return quantize_leaf(leaf, mode)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(quantize, params)
+
+
+def dequantize_streamed(params, compute=None):
+    """Replace every :class:`QuantizedLeaf` in ``params`` with its
+    dequantized matrix (identity — the same tree object — when nothing
+    is quantized, so wrapping an unquantized path costs nothing and
+    changes no bits)."""
+    leaves = jax.tree_util.tree_leaves(params, is_leaf=_is_quantized)
+    if not any(_is_quantized(leaf) for leaf in leaves):
+        return params
+    return jax.tree_util.tree_map(
+        lambda leaf: dequantize_leaf(leaf, compute) if _is_quantized(leaf)
+        else leaf,
+        params, is_leaf=_is_quantized)
+
+
+def qdot(x, w, *, compute=None):
+    """Quantization-aware ``x @ w`` with f32 accumulation.
+
+    For a :class:`QuantizedLeaf`, the streamed narrow values are the
+    matmul operand (cast to the compute dtype tile-side — the form whose
+    HBM traffic is the narrow bytes) and the per-channel scale multiplies
+    the f32 accumulator once — the exact epilogue the Pallas decode
+    kernels apply, so this is their einsum fallback/reference. Plain
+    arrays degrade to a cast matmul. Returns float32."""
+    contract = (((x.ndim - 1,), (0,)), ((), ()))
+    if isinstance(w, QuantizedLeaf):
+        compute = jnp.dtype(compute or x.dtype)
+        product = f32_accum_dot(x, w.values.astype(compute), contract)
+        return product * w.scales.reshape(-1)
+    return f32_accum_dot(x, w.astype(compute or x.dtype), contract)
+
+
+@functools.lru_cache(maxsize=None)
+def fp8_unsupported_reason() -> str | None:
+    """Capability probe: can this jax/jaxlib cast to and matmul from
+    ``float8_e4m3fn`` on the current backend? ``None`` when it can, else
+    a reason string for ``pytest.mark.skipif`` / the ``stream_dtype=
+    'fp8'`` gate. Cached in-process AND on disk keyed by the
+    jax/jaxlib/python versions and backend (the PartitionId probe's
+    discipline, ``parallel/mesh.py``) so the probe compiles once per
+    installation. Unlike that probe this one runs in-process: an
+    unsupported fp8 dtype raises a catchable TypeError/not-implemented,
+    it never hard-aborts the runtime."""
+    import pathlib
+    import sys
+    import tempfile
+    try:
+        import jaxlib
+        jaxlib_version = getattr(jaxlib, '__version__', '?')
+    except ImportError:
+        jaxlib_version = '?'
+    key = (f'{jax.__version__}-{jaxlib_version}-'
+           f'py{sys.version_info[0]}.{sys.version_info[1]}-'
+           f'{jax.default_backend()}')
+    cache = pathlib.Path(tempfile.gettempdir()) / f'tpusystem-fp8-{key}.txt'
+    try:
+        cached = cache.read_text()
+        return None if cached == 'ok' else cached
+    except OSError:
+        pass
+    if not hasattr(jnp, 'float8_e4m3fn'):
+        reason = 'this jax has no float8_e4m3fn dtype'
+    else:
+        try:
+            @jax.jit
+            def probe(x):
+                narrow = x.astype(jnp.float8_e4m3fn)
+                return f32_accum_dot(narrow.astype(jnp.float32), narrow
+                                     .astype(jnp.float32),
+                                     (((1,), (0,)), ((), ())))
+            total = float(jnp.sum(probe(jnp.ones((8, 8), jnp.float32))))
+            reason = (None if total == 8.0 ** 3
+                      else f'fp8 round trip returned {total}, expected 512')
+        except Exception as error:   # unsupported lowering on this backend
+            reason = f'fp8 ops failed on {jax.default_backend()}: ' \
+                     f'{str(error)[:200]}'
+    try:
+        cache.write_text('ok' if reason is None else reason)
+    except OSError:
+        pass
+    return reason

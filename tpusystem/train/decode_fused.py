@@ -1,0 +1,200 @@
+"""The fused decode loop — ``generate(decode_impl='fused')``.
+
+The flax decode path dispatches ~6 XLA ops per matrix param per token
+step and streams every weight at the tree's storage width. This module
+is the serving-path alternative: one hand-rolled GPT-2 token-step whose
+four per-layer matmuls run through the Pallas decode kernels
+(:mod:`tpusystem.ops.pallas.decode_matmul`) — the ``[B, dim]``
+activation resident in VMEM, weights streamed tile-by-tile, int8/fp8
+tiles dequantized in-kernel against their per-channel scales (so
+``stream_dtype='int8'|'fp8'`` keeps its narrow HBM traffic inside the
+compiled loop instead of being hoisted into a wide copy), and the
+fc→gelu→proj pair fused into ONE kernel whose hidden activation never
+exists in HBM.
+
+Contract: **the same tokens as the flax path.** The step math mirrors
+``GPT2.__call__`` in decode mode op for op — f32 layernorms (flax
+fast-variance form), the bucketed cache read of
+:func:`tpusystem.ops.attention.cached_attention` (smallest power-of-2
+window covering the filled prefix, ``lax.switch`` over static widths),
+f32-accumulated matmuls, the tied f32-logit head — and prefill runs
+through the flax module itself, so the cache layout and prompt logits
+are the flax path's own. Greedy decode is token-exact against
+``decode_impl='flax'`` in window-invariant arithmetic (CPU f32; TPU at
+``jax_default_matmul_precision='highest'``) and matches within the
+platform's near-tie argmax tolerance at default MXU precision —
+the speculative-verify caveat, same cause.
+
+Scope: the unrolled dense GPT-2 family (``fused_unsupported_reason``
+names the exact gate). Llama/MoE/scanned stacks fall back to the flax
+path under ``decode_impl='auto'`` and raise under an explicit
+``'fused'``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from tpusystem.ops.attention import NEG_INF
+from tpusystem.ops.pallas.decode_matmul import decode_ffn, decode_matmul
+from tpusystem.ops.precision import dequantize_streamed, head_logits
+
+
+def fused_unsupported_reason(decoder) -> str | None:
+    """Why ``decode_impl='fused'`` cannot run this decode clone, or
+    ``None`` when it can. The fused step re-implements the GPT-2 dense
+    token-step; anything whose step math differs falls back."""
+    from tpusystem.models.gpt2 import GPT2
+    if not isinstance(decoder, GPT2):
+        return ("the fused decode step implements the GPT2 family only "
+                f"(got {type(decoder).__name__})")
+    if decoder.scan_layers:
+        return ('scan_layers stacks params under a leading layer dim the '
+                'fused per-layer sweep does not walk')
+    if decoder.moe_experts:
+        return 'MoE blocks route through expert dispatch, not the FFN chain'
+    if decoder.per_row_decode:
+        return ('per-row cache cursors (the speculative path) need the '
+                'scatter cache write')
+    return None
+
+
+def _layernorm(x, scale, bias):
+    """flax ``nn.LayerNorm(dtype=float32)`` numerics: f32, fast variance
+    (``E[x^2] - E[x]^2``), epsilon 1e-6."""
+    x = x.astype(jnp.float32)
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True) - jnp.square(mean)
+    inv = jax.lax.rsqrt(var + 1e-6)
+    return (x - mean) * inv * scale + bias
+
+
+def _bucketed_attention(query, key_cache, value_cache, cursor, max_seq: int):
+    """One-token bucketed cache attention — ``cached_attention``'s read
+    path (same buckets, same mask, same f32 softmax) for ``[B, H, hd]``
+    queries against ``[B, S, H, hd]`` caches at per-row depth ``cursor``."""
+    compute = query.dtype
+    head_dim = query.shape[-1]
+    scale = head_dim ** -0.5
+
+    def attend_over(width: int):
+        def run():
+            keys = jax.lax.slice_in_dim(key_cache, 0, width, axis=1)
+            values = jax.lax.slice_in_dim(value_cache, 0, width, axis=1)
+            scores = jnp.einsum('bhd,bwhd->bhw', query, keys,
+                                preferred_element_type=jnp.float32) * scale
+            mask = jnp.arange(width)[None, None, :] <= cursor[:, None, None]
+            scores = jnp.where(mask, scores, NEG_INF)
+            weights = jax.nn.softmax(scores, axis=-1)
+            return jnp.einsum('bhw,bwhd->bhd', weights.astype(compute),
+                              values)
+        return run
+
+    buckets = [256]
+    while buckets[-1] < max_seq:
+        buckets.append(min(2 * buckets[-1], max_seq))
+    if len(buckets) == 1:
+        return attend_over(max_seq)()
+    filled = jnp.max(cursor) + 1
+    bucket_index = sum((filled > width).astype(jnp.int32)
+                       for width in buckets[:-1])
+    return jax.lax.switch(bucket_index, [attend_over(w) for w in buckets])
+
+
+@functools.cache
+def compiled_fused(decoder, steps: int, temperature: float):
+    return build_fused(decoder, steps, temperature)
+
+
+def build_fused(decoder, steps: int, temperature: float):
+    """The fused greedy/sampling decode runner: flax prefill, then
+    ``steps - 1`` fused token-steps under ``lax.scan``. Accepts plain,
+    pre-cast, or quantized param trees (the flax prefill consumes a
+    dequantized view; the scan streams the tree as passed)."""
+    from tpusystem.train.generate import _sample
+    layers, heads = decoder.layers, decoder.heads
+    dim, max_seq = decoder.dim, decoder.max_seq
+    head_dim = dim // heads
+    compute = jnp.dtype(decoder.dtype)
+
+    def token_step(params, k_caches, v_caches, cursor, token):
+        wide = token.shape[0]
+        start = cursor[0]      # ordinary decode: uniform cursor contract
+        wte = params['wte']['embedding']
+        wpe = params['wpe']['embedding']
+        embedded = (jnp.asarray(wte)[token].astype(jnp.float32)
+                    + jnp.asarray(wpe)[cursor].astype(jnp.float32))
+        hidden = embedded.astype(compute)
+        new_k, new_v = [], []
+        for index in range(layers):
+            block = params[f'h_{index}']
+            normed = _layernorm(hidden, block['ln_1']['scale'],
+                                block['ln_1']['bias']).astype(compute)
+            attn = block['attn']
+            qkv = decode_matmul(normed, attn['qkv']['kernel'],
+                                attn['qkv']['bias'])
+            query, key, value = jnp.split(qkv, 3, axis=-1)
+            shape = (wide, heads, head_dim)
+            query = query.reshape(shape)
+            key_cache = jax.lax.dynamic_update_slice(
+                k_caches[index],
+                key.reshape((wide, 1) + shape[1:]).astype(
+                    k_caches[index].dtype), (0, start, 0, 0))
+            value_cache = jax.lax.dynamic_update_slice(
+                v_caches[index],
+                value.reshape((wide, 1) + shape[1:]).astype(
+                    v_caches[index].dtype), (0, start, 0, 0))
+            new_k.append(key_cache)
+            new_v.append(value_cache)
+            context = _bucketed_attention(query, key_cache, value_cache,
+                                          cursor, max_seq)
+            attended = decode_matmul(context.reshape(wide, dim),
+                                     attn['out']['kernel'],
+                                     attn['out']['bias'])
+            hidden = hidden + attended
+            normed = _layernorm(hidden, block['ln_2']['scale'],
+                                block['ln_2']['bias']).astype(compute)
+            hidden = hidden + decode_ffn(
+                normed, block['fc']['kernel'], block['fc']['bias'],
+                block['proj']['kernel'], block['proj']['bias'],
+                activation=jax.nn.gelu)
+        final = _layernorm(hidden, params['ln_f']['scale'],
+                           params['ln_f']['bias'])
+        table = jnp.asarray(wte).astype(compute)
+        logits = head_logits(final.astype(compute), table, tied=True)
+        return logits, tuple(new_k), tuple(new_v)
+
+    @jax.jit
+    def run(params, prompt, rng):
+        # prefill through the flax module itself: identical prompt
+        # logits and cache layout, flash long-prompt routing included
+        plain = dequantize_streamed(params, compute)
+        logits, state = decoder.apply({'params': plain}, prompt,
+                                      mutable=['cache'])
+        cache = state['cache']
+        k_caches = tuple(cache[f'h_{i}']['attn']['key']
+                         for i in range(layers))
+        v_caches = tuple(cache[f'h_{i}']['attn']['value']
+                         for i in range(layers))
+        cursor = cache['position']                       # [B], uniform
+        rng, key = jax.random.split(rng)
+        token = _sample(logits[:, -1], temperature, key)
+
+        def step(carry, _):
+            k_caches, v_caches, cursor, token, rng = carry
+            logits, k_caches, v_caches = token_step(
+                params, k_caches, v_caches, cursor, token)
+            rng, key = jax.random.split(rng)
+            next_token = _sample(logits, temperature, key)
+            return (k_caches, v_caches, cursor + 1, next_token, rng), token
+
+        (_, _, _, last, _), generated = jax.lax.scan(
+            step, (k_caches, v_caches, cursor, token, rng), None,
+            length=steps - 1)
+        generated = jnp.moveaxis(generated, 0, 1)        # [B, steps-1]
+        return jnp.concatenate([prompt, generated, last[:, None]], axis=1)
+
+    return run

@@ -39,19 +39,35 @@ def _decoder(module, per_row: bool = False):
     return dataclasses.replace(module, **updates)
 
 
+STREAM_DTYPES = ('auto', 'float32', 'bfloat16', 'int8', 'fp8')
+
+
 def _stream_params(decoder, params, stream_dtype: str):
-    """Pre-cast f32 matrix leaves to the decode compute dtype (see
-    ``generate``'s ``stream_dtype``). No-op for f32-compute modules."""
+    """Transform the streamed param tree per ``generate``'s
+    ``stream_dtype``: pre-cast f32 matrix leaves to the compute dtype
+    (``'auto'`` — no-op for f32-compute modules — or an explicit
+    ``'bfloat16'``), or quantize them to per-channel-scaled narrow
+    leaves (``'int8'``/``'fp8'``). ``'float32'`` streams the masters
+    untouched."""
     if stream_dtype == 'float32':
         return params
-    if stream_dtype != 'auto':
+    if stream_dtype not in STREAM_DTYPES:
         raise ValueError(f'unknown stream_dtype {stream_dtype!r}; '
-                         "expected 'auto' or 'float32'")
-    compute = jnp.dtype(getattr(decoder, 'dtype', jnp.float32))
-    if compute.itemsize >= jnp.dtype(jnp.float32).itemsize:
-        return params
-
-    return _caster(compute.name)(params)
+                         f'expected one of {STREAM_DTYPES}')
+    if stream_dtype == 'auto':
+        compute = jnp.dtype(getattr(decoder, 'dtype', jnp.float32))
+        if compute.itemsize >= jnp.dtype(jnp.float32).itemsize:
+            return params
+        return _caster(compute.name)(params)
+    if stream_dtype == 'bfloat16':
+        return _caster('bfloat16')(params)
+    if stream_dtype == 'fp8':
+        from tpusystem.ops.precision import fp8_unsupported_reason
+        reason = fp8_unsupported_reason()
+        if reason is not None:
+            raise ValueError(f"stream_dtype='fp8' is unavailable here: "
+                             f'{reason}')
+    return _quantizer(stream_dtype)(params)
 
 
 @functools.cache
@@ -79,6 +95,39 @@ def _caster(compute_name: str):
     return jax.jit(functools.partial(jax.tree_util.tree_map_with_path, cast))
 
 
+@functools.cache
+def _quantizer(mode: str):
+    """One cached jitted quantize program per narrow mode — the same
+    retrace trap ``_caster`` pins (an uncached jit would retrace the
+    whole-tree quantization on every ``generate`` call; measured 8x
+    slower decode for the caster's version of this mistake). The leaf
+    rule (matrices only, embedding/router excluded) lives in
+    :func:`tpusystem.ops.precision.quantize_streamed`."""
+    from tpusystem.ops.precision import quantize_streamed
+    return jax.jit(functools.partial(quantize_streamed, mode=mode))
+
+
+def streamed_bytes(module, params, stream_dtype: str) -> int:
+    """Per-step streamed bytes of :func:`generate`'s param tree under one
+    ``stream_dtype`` — the decode roofline quantity (weight bytes
+    crossing HBM per token step; quantized modes count narrow values
+    plus their per-channel scales, and embeddings/routers/vectors stay
+    f32 per the leaf rule). The one accounting shared by ``bench.py``,
+    ``benchmarks/decode_roofline.py``, and the dryrun decode stage."""
+    streamed = _stream_params(_decoder(module), params, stream_dtype)
+    return sum(leaf.nbytes for leaf in jax.tree_util.tree_leaves(streamed))
+
+
+def _dequant(params, decoder):
+    """Dequantized view of a (possibly) quantized streamed tree in the
+    module's compute dtype — called INSIDE the compiled decode loop's
+    body so the narrow values stay the HBM-resident operand (identity —
+    same tree object, zero bits changed — for unquantized trees)."""
+    from tpusystem.ops.precision import dequantize_streamed
+    compute = jnp.dtype(getattr(decoder, 'dtype', jnp.float32))
+    return dequantize_streamed(params, compute)
+
+
 def _sample(logits, temperature: float, rng):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -88,7 +137,7 @@ def _sample(logits, temperature: float, rng):
 
 def generate(module, params, prompt, *, steps: int,
              temperature: float = 0.0, rng=None,
-             stream_dtype: str = 'auto'):
+             stream_dtype: str = 'auto', decode_impl: str = 'auto'):
     """Generate ``steps`` tokens after ``prompt``.
 
     Args:
@@ -98,18 +147,39 @@ def generate(module, params, prompt, *, steps: int,
         steps: tokens to generate per sequence.
         temperature: 0 = greedy argmax; otherwise categorical sampling.
         rng: ``jax.random`` key (required when ``temperature > 0``).
-        stream_dtype: ``'auto'`` (default) pre-casts float32 matrix
-            kernels (ndim >= 2) to the module's compute dtype when that
-            dtype is narrower. Decode at small batch is weight-STREAMING
-            bound, and a bf16-compute model casts its f32 kernels to
-            bf16 at every use anyway — the cast changes which bytes a
-            decode-only process keeps resident, not the matmul numerics.
-            Leaves the model consumes at f32 are NOT cast: embedding
-            tables (the embed step adds wte+wpe rows in f32 — for GPT-2
-            the tied table is the part whose footprint does not halve),
-            MoE router weights (routing runs in f32), and vector leaves
-            (biases, layernorm scales). ``'float32'`` streams the
-            masters untouched (the training layout).
+        stream_dtype: what the decode loop streams from HBM each step —
+            decode at small batch is weight-STREAMING bound, so this is
+            the tokens/sec lever (benchmarks/decode_roofline.py).
+            ``'auto'`` (default) pre-casts float32 matrix kernels
+            (ndim >= 2) to the module's compute dtype when that dtype is
+            narrower: a bf16-compute model casts its f32 kernels to bf16
+            at every use anyway, so the cast changes which bytes stay
+            resident, not the matmul numerics. ``'bfloat16'`` forces
+            that cast regardless of the compute dtype (identical program
+            to ``'auto'`` on bf16 modules; bf16-rounds the weights of
+            f32 modules). ``'int8'`` / ``'fp8'`` quantize the same
+            leaves with per-output-channel symmetric scales
+            (:func:`tpusystem.ops.precision.quantize_streamed`) —
+            2x/2x fewer weight bytes than bf16, dequantized per use
+            inside the loop body (or in-kernel under the fused impl),
+            greedy tokens equal up to the bounded quantization error;
+            ``'fp8'`` needs the capability probe
+            (:func:`~tpusystem.ops.precision.fp8_unsupported_reason`)
+            to pass. In every mode, leaves the model consumes at f32
+            are untouched: embedding tables (the embed step adds
+            wte+wpe rows in f32 — for GPT-2 the tied table is the part
+            whose footprint does not shrink), MoE routers (f32 gate
+            logits), and vector leaves (biases, layernorm scales).
+            ``'float32'`` streams the masters untouched (the training
+            layout).
+        decode_impl: which token-step runs the decode loop. ``'flax'``
+            is the module's own apply (the reference path);
+            ``'fused'`` the Pallas fused decode chain
+            (:mod:`tpusystem.train.decode_fused`: activation resident
+            in VMEM, weights — quantized or not — streamed tile-by-tile,
+            fc→gelu→proj in one kernel), raising when the module is
+            outside its scope; ``'auto'`` (default) picks ``'fused'``
+            on TPU where supported and ``'flax'`` elsewhere.
 
     Returns:
         int32 ``[batch, prompt_len + steps]`` — prompt plus generation.
@@ -125,6 +195,14 @@ def generate(module, params, prompt, *, steps: int,
         raise ValueError(
             f'prompt ({prompt.shape[1]}) + steps ({steps}) exceeds the '
             f'cache capacity max_seq={decoder.max_seq}')
+    impl = _resolve_impl(decode_impl, decoder)
+    if impl == 'fused':
+        from tpusystem.train import decode_fused
+        try:
+            run = decode_fused.compiled_fused(decoder, steps, temperature)
+        except TypeError:   # unhashable module field (e.g. a live mesh)
+            run = decode_fused.build_fused(decoder, steps, temperature)
+        return run(params, prompt, rng)
     try:
         # jit caches key on function identity: reuse one compiled program
         # per (decoder config, steps, temperature) across generate() calls
@@ -134,9 +212,32 @@ def generate(module, params, prompt, *, steps: int,
     return run(params, prompt, rng)
 
 
+def _resolve_impl(decode_impl: str, decoder) -> str:
+    """'flax' | 'fused' for this decode clone. 'auto' is conservative:
+    fused only on TPU backends (where the Pallas kernels compile to real
+    streaming; elsewhere they would run interpreted) and only for
+    modules inside the fused step's scope."""
+    if decode_impl not in ('auto', 'flax', 'fused'):
+        raise ValueError(f'unknown decode_impl {decode_impl!r}; '
+                         "expected 'auto', 'flax' or 'fused'")
+    if decode_impl == 'flax':
+        return 'flax'
+    from tpusystem.train.decode_fused import fused_unsupported_reason
+    reason = fused_unsupported_reason(decoder)
+    if decode_impl == 'fused':
+        if reason is not None:
+            raise ValueError(
+                f"decode_impl='fused' cannot run this module: {reason}")
+        return 'fused'
+    if reason is None and jax.default_backend() in ('tpu', 'axon'):
+        return 'fused'
+    return 'flax'
+
+
 def speculative_generate(module, params, prompt, *, steps: int,
                          draft_module, draft_params, speculate: int = 4,
-                         temperature: float = 0.0, rng=None):
+                         temperature: float = 0.0, rng=None,
+                         stream_dtype: str = 'auto', tree_fanout: int = 1):
     """Generation accelerated by a draft model (speculative decoding).
 
     The draft proposes ``speculate`` tokens autoregressively (cheap model,
@@ -164,8 +265,29 @@ def speculative_generate(module, params, prompt, *, steps: int,
     Cache cursors are **per-row** (the caches write and mask at each row's
     own depth), so every sequence advances by its own acceptance count —
     one slow row no longer drags the whole batch to its acceptance, and
-    the speedup survives batching. Rows that reach ``steps`` idle (their
-    cursor and output stop advancing) until the slowest row finishes.
+    the speedup survives batching: the verify forward runs the WHOLE
+    batch's K+1-token windows through one weight pass, so its streaming
+    cost amortizes over every row (the batch-1 trajectory is reproduced
+    row for row — pinned by tests). Rows that reach ``steps`` idle
+    (their cursor and output stop advancing) until the slowest row
+    finishes.
+
+    ``stream_dtype`` applies :func:`generate`'s weight-streaming modes to
+    the target AND draft param trees (quantized modes dequantize inside
+    each round's bodies, so the verify pass streams narrow bytes too).
+
+    ``tree_fanout=F > 1`` switches greedy decoding to **token-tree
+    verify**: each sequence drafts ``F`` branches — the draft's top-F
+    first tokens, each continued greedily to ``speculate`` tokens — and
+    the target verifies all branches as extra batch rows in the SAME
+    single forward (one weight pass, ``batch*F`` verify rows). The
+    branch with the longest accepted prefix wins the round, so
+    acceptance length grows without extra target passes; losing
+    branches' cache rows are overwritten from the winner before the
+    next round. Greedy only (``temperature=0`` — every branch's
+    accepted tokens are target-greedy-verified, so the output is still
+    **exactly the target's greedy decode**); capacity accounting is
+    unchanged (the tree widens the batch, not the window).
 
     Returns int32 ``[batch, prompt_len + steps]`` like :func:`generate`.
     """
@@ -173,11 +295,20 @@ def speculative_generate(module, params, prompt, *, steps: int,
         raise ValueError(f'steps must be >= 1, got {steps}')
     if speculate < 1:
         raise ValueError(f'speculate must be >= 1, got {speculate}')
+    if tree_fanout < 1:
+        raise ValueError(f'tree_fanout must be >= 1, got {tree_fanout}')
+    if tree_fanout > 1 and temperature > 0.0:
+        raise ValueError(
+            'tree_fanout > 1 implements greedy token-tree verify only; '
+            'rejection-sampling over trees is not implemented — use '
+            'tree_fanout=1 for temperature sampling')
     if temperature > 0.0 and rng is None:
         raise ValueError('temperature sampling needs an rng key')
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     decoder = _decoder(module, per_row=True)
     drafter = _decoder(draft_module, per_row=True)
+    params = _stream_params(decoder, params, stream_dtype)
+    draft_params = _stream_params(drafter, draft_params, stream_dtype)
     needed = prompt.shape[1] + steps + speculate + 1
     capacity = min(decoder.max_seq, drafter.max_seq)
     if needed > capacity:
@@ -185,6 +316,17 @@ def speculative_generate(module, params, prompt, *, steps: int,
             f'prompt + steps + speculate + 1 = {needed} exceeds the cache '
             f'capacity max_seq={capacity} (verification overshoots by up to '
             f'speculate tokens before rewinding)')
+    if tree_fanout > 1:
+        if tree_fanout > drafter.vocab_size:
+            raise ValueError(f'tree_fanout ({tree_fanout}) exceeds the '
+                             f'draft vocab ({drafter.vocab_size})')
+        try:
+            run = _compiled_speculative_tree(decoder, drafter, steps,
+                                             speculate, tree_fanout)
+        except TypeError:   # unhashable module field
+            run = _build_speculative_tree(decoder, drafter, steps,
+                                          speculate, tree_fanout)
+        return run(params, draft_params, prompt, rng)
     try:
         run = _compiled_speculative(decoder, drafter, steps, speculate,
                                     temperature)
@@ -226,10 +368,11 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
     @jax.jit
     def run(params, draft_params, prompt, rng):
         batch, prefix = prompt.shape
-        tlogits, tstate = decoder.apply({'params': params}, prompt,
-                                        mutable=['cache'])
-        _, dstate = drafter.apply({'params': draft_params}, prompt,
-                                  mutable=['cache'])
+        tlogits, tstate = decoder.apply(
+            {'params': _dequant(params, decoder)}, prompt, mutable=['cache'])
+        _, dstate = drafter.apply(
+            {'params': _dequant(draft_params, drafter)}, prompt,
+            mutable=['cache'])
         rng, key = jax.random.split(rng)
         token = _sample(tlogits[:, -1], temperature, key)
         # padded so a full window write at the last offset stays in bounds
@@ -247,8 +390,8 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
             def draft_step(state, key):
                 cache, tok = state
                 logits, updated = drafter.apply(
-                    {'params': draft_params, 'cache': cache}, tok[:, None],
-                    mutable=['cache'])
+                    {'params': _dequant(draft_params, drafter),
+                     'cache': cache}, tok[:, None], mutable=['cache'])
                 logits = logits[:, -1]
                 nxt = _sample(logits, temperature, key)
                 return (updated['cache'], nxt), (nxt, logits)
@@ -264,8 +407,8 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
             # one target forward over the whole proposed window
             window = jnp.concatenate([token[:, None], drafts], axis=1)
             vlogits, tupdated = decoder.apply(
-                {'params': params, 'cache': tcache}, window,
-                mutable=['cache'])
+                {'params': _dequant(params, decoder), 'cache': tcache},
+                window, mutable=['cache'])
 
             if temperature == 0.0:
                 # acceptance = exact match against the target's greedy
@@ -347,6 +490,149 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
     return run
 
 
+def _gather_rows(cache, rows):
+    """Overwrite every branch row's cache with its group winner's
+    (token-tree verify): KV leaves gather on their batch axis — always
+    ``ndim - 4`` for the ``[..., batch, max_seq, heads, head_dim]``
+    cache layout, which also covers scanned stacks' leading layer dim —
+    and cursor leaves (``index``/``position``) on their last axis."""
+    cursors = (jax.tree_util.DictKey('index'),
+               jax.tree_util.DictKey('position'))
+
+    def fix(path, leaf):
+        axis = leaf.ndim - 1 if path[-1] in cursors else leaf.ndim - 4
+        return jnp.take(leaf, rows, axis=axis)
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.cache
+def _compiled_speculative_tree(decoder, drafter, steps: int, speculate: int,
+                               fanout: int):
+    return _build_speculative_tree(decoder, drafter, steps, speculate, fanout)
+
+
+def _build_speculative_tree(decoder, drafter, steps: int, speculate: int,
+                            fanout: int):
+    """Greedy token-tree verify: each sequence owns ``fanout`` adjacent
+    branch rows (row ``b*F + f`` is branch ``f`` of sequence ``b``) whose
+    caches hold identical history at every round start. The draft fans
+    the tree at its first step (branch ``f`` takes the draft's f-th most
+    probable token) and continues each branch greedily; ONE target
+    forward verifies all ``batch*F`` windows; the branch with the
+    longest target-greedy-accepted prefix wins the round and its cache
+    rows are copied over its siblings'. Output invariant: every emitted
+    token is the target's own greedy choice given the accepted prefix,
+    so the result is exactly :func:`generate`'s greedy decode — the tree
+    only changes how many tokens each weight pass yields."""
+    K, F = speculate, fanout
+
+    @jax.jit
+    def run(params, draft_params, prompt, rng):
+        del rng                                  # greedy only
+        batch, prefix = prompt.shape
+        wide = batch * F
+        prompt_wide = jnp.repeat(prompt, F, axis=0)    # branches adjacent
+        tlogits, tstate = decoder.apply(
+            {'params': _dequant(params, decoder)}, prompt_wide,
+            mutable=['cache'])
+        _, dstate = drafter.apply(
+            {'params': _dequant(draft_params, drafter)}, prompt_wide,
+            mutable=['cache'])
+        token = jnp.argmax(tlogits[:, -1], axis=-1).astype(jnp.int32)
+        out = jnp.zeros((batch, steps + K + 1), jnp.int32)
+        out = out.at[:, 0].set(token[::F])
+        branch = jnp.arange(wide) % F            # branch id per wide row
+
+        def cond(carry):
+            return jnp.min(carry[0]) < steps
+
+        def body(carry):
+            produced, cursor, token, out, tcache, dcache = carry
+            done = produced >= steps                       # [B] idle rows
+
+            def draft_step(state, step_index):
+                cache, tok = state
+                logits, updated = drafter.apply(
+                    {'params': _dequant(draft_params, drafter),
+                     'cache': cache}, tok[:, None], mutable=['cache'])
+                logits = logits[:, -1]
+                # step 0 fans the tree out: sibling rows see identical
+                # logits, branch f takes the f-th most probable token;
+                # later steps continue each branch greedily
+                _, top = jax.lax.top_k(logits, F)
+                fanned = jnp.take_along_axis(
+                    top, branch[:, None], axis=1)[:, 0]
+                greedy = jnp.argmax(logits, axis=-1)
+                nxt = jnp.where(step_index == 0, fanned,
+                                greedy).astype(jnp.int32)
+                return (updated['cache'], nxt), nxt
+
+            # K+1 steps for the same reason as the linear path: a fully
+            # accepted winner's draft cache must hold d_K's KV
+            (dcache, _), drafts = jax.lax.scan(
+                draft_step, (dcache, token), jnp.arange(K + 1))
+            drafts = jnp.moveaxis(drafts, 0, 1)[:, :K]     # [B*F, K]
+
+            # one target forward verifies every branch of every sequence
+            window = jnp.concatenate([token[:, None], drafts], axis=1)
+            vlogits, tupdated = decoder.apply(
+                {'params': _dequant(params, decoder), 'cache': tcache},
+                window, mutable=['cache'])
+            candidates = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            matches = (drafts == candidates[:, :K]).astype(jnp.int32)
+            accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+
+            # the longest accepted prefix wins its group; argmax ties
+            # resolve to the lowest branch id = the draft's most
+            # probable branch
+            per_group = accepted.reshape(batch, F)
+            winner = jnp.argmax(per_group, axis=1).astype(jnp.int32)
+            accepted_w = jnp.max(per_group, axis=1)        # [B]
+            win_rows = jnp.arange(batch) * F + winner
+            drafts_w = jnp.take(drafts, win_rows, axis=0)
+            correction = jnp.take_along_axis(
+                jnp.take(candidates, win_rows, axis=0),
+                accepted_w[:, None], axis=1)[:, 0]
+
+            positions = jnp.arange(K + 1)[None, :]
+            emitted = jnp.where(
+                positions < accepted_w[:, None],
+                jnp.pad(drafts_w, ((0, 0), (0, 1))),
+                jnp.where(positions == accepted_w[:, None],
+                          correction[:, None], 0))
+            columns = jnp.where(done[:, None], out.shape[1],
+                                produced[:, None] + positions)
+            out = out.at[jnp.arange(batch)[:, None], columns].set(
+                emitted, mode='drop')
+
+            advance = jnp.where(done, 0, accepted_w + 1)
+            produced = produced + advance
+            cursor = cursor + jnp.repeat(advance, F)
+            # park finished groups' cursors at the prompt end — the
+            # linear path's capacity discipline, branch-row flavored
+            cursor = jnp.where(jnp.repeat(produced >= steps, F),
+                               jnp.minimum(cursor, prefix), cursor)
+            next_token = jnp.take_along_axis(
+                emitted, accepted_w[:, None], axis=1)[:, 0]
+            token = jnp.where(jnp.repeat(done, F), token,
+                              jnp.repeat(next_token, F))
+            # losing branches inherit the winner's cache rows, then every
+            # row rewinds to the group's accepted depth
+            rowmap = jnp.repeat(win_rows, F)
+            tcache = _rewind(_gather_rows(tupdated['cache'], rowmap),
+                             cursor)
+            dcache = _rewind(_gather_rows(dcache, rowmap), cursor)
+            return (produced, cursor, token, out, tcache, dcache)
+
+        carry = (jnp.full((batch,), 1, jnp.int32),
+                 jnp.full((wide,), prefix, jnp.int32), token, out,
+                 tstate['cache'], dstate['cache'])
+        _, _, _, out, _, _ = jax.lax.while_loop(cond, body, carry)
+        return jnp.concatenate([prompt, out[:, :steps]], axis=1)
+
+    return run
+
+
 @functools.cache
 def _compiled(decoder, steps: int, temperature: float):
     return _build(decoder, steps, temperature)
@@ -357,16 +643,19 @@ def _build(decoder, steps: int, temperature: float):
     @jax.jit
     def run(params, prompt, rng):
         # prefill: one pass over the prompt builds every layer's cache
-        logits, state = decoder.apply({'params': params}, prompt,
-                                      mutable=['cache'])
+        logits, state = decoder.apply({'params': _dequant(params, decoder)},
+                                      prompt, mutable=['cache'])
         rng, key = jax.random.split(rng)
         token = _sample(logits[:, -1], temperature, key)
 
         def step(carry, _):
             cache, token, rng = carry
+            # dequantize INSIDE the loop body: the narrow leaves stay
+            # the HBM-resident operand, the wide view is per-step
+            # transient (identity for unquantized trees)
             logits, updated = decoder.apply(
-                {'params': params, 'cache': cache}, token[:, None],
-                mutable=['cache'])
+                {'params': _dequant(params, decoder), 'cache': cache},
+                token[:, None], mutable=['cache'])
             rng, key = jax.random.split(rng)
             next_token = _sample(logits[:, -1], temperature, key)
             return (updated['cache'], next_token, rng), token
