@@ -14,8 +14,10 @@ time on the tiny model — the per-recovery saving the Supervisor's
 memstore tier buys), then the ``decode_tok_s``/``decode_stream_bytes``
 rows (serving-path greedy decode throughput at the BASELINE decode
 config plus the per-step streamed weight bytes auto-vs-int8 — the
-roofline lever, ``benchmarks/decode_roofline.py``), then the headline
-as the LAST JSON line (the one the driver parses):
+roofline lever, ``benchmarks/decode_roofline.py``), then the
+``serve_tok_s`` row (continuous batching vs static padded batching
+through the serving engine, ``benchmarks/serve_bench.py headline``),
+then the headline as the LAST JSON line (the one the driver parses):
 ``{"metric": ..., "value": N, "spread": N, "unit": ..., "vs_baseline": N}``.
 
 ``value`` is the **median of TRIALS (>= 3) timed runs** after a shared
@@ -108,6 +110,15 @@ def fsdp_overlap_row() -> None:
     overlap scheduler's second client, `parallel/schedule.py`; BASELINE.md
     "fsdp_overlap protocol")."""
     _overlap_probe_row('fsdp_overlap.py', 'fsdp_overlap_speedup_vs_gspmd')
+
+
+def serve_row() -> None:
+    """The serving-engine throughput row: continuous batching (paged KV
+    + iteration-level scheduling, `tpusystem/serve/`) vs static padded
+    batching on a mixed-length workload (`benchmarks/serve_bench.py`;
+    BASELINE.md "serve protocol" — CPU numbers are smoke, the >= 2x
+    speedup ratio is the architectural claim)."""
+    _overlap_probe_row('serve_bench.py', 'serve_tok_s')
 
 
 BATCH, SEQ = 16, 1024
@@ -378,4 +389,5 @@ if __name__ == '__main__':
     sentinel_overhead_row()
     recovery_seconds_row()
     decode_rows()
+    serve_row()
     main()

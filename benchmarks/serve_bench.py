@@ -1,0 +1,163 @@
+"""Serving throughput: continuous batching vs static padded batching.
+
+The serving engine's reason to exist, measured: a mixed-length synthetic
+workload (short and long generations interleaved, the shape real traffic
+has) served two ways —
+
+1. ``static``     — classic padded batching: requests grouped in arrival
+                    order into fixed batches of ``ROWS``, prompts padded
+                    to the workload's widest bucket, every row decoded to
+                    its group's LONGEST request (the whole batch waits on
+                    the straggler; short rows burn steps on tokens nobody
+                    asked for). One ``generate()`` call per group — all
+                    groups share one compiled program.
+2. ``continuous`` — the paged engine (`tpusystem/serve/`): iteration-
+                    level scheduling admits a queued request the moment a
+                    row frees, so a retired short request's row is
+                    immediately producing a new request's tokens instead
+                    of padding out the straggler.
+
+Tokens/sec counts only **delivered** tokens (what each request asked
+for) over wall time, so the static arm pays for its dead rows. Per-phase
+rows decompose the continuous arm (prefill / admit / decode dispatch
+time from the engine's own counters).
+
+Every row is one machine-readable JSON line (the ``decode_roofline.py``
+convention); the LAST line is the ``serve_tok_s`` headline ``bench.py``
+forwards. On CPU the numbers are smoke (documented in BASELINE.md
+"serve protocol" — the TPU protocol uses the 125M decode config); the
+*ratio* is the architectural claim: continuous batching >= 2x static on
+this workload.
+
+Run: ``python benchmarks/serve_bench.py [headline]``.
+"""
+
+from __future__ import annotations
+
+import sys
+sys.path.insert(0, str(__import__('pathlib').Path(__file__).parent.parent))
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import materialize
+from tpusystem.models import GPT2, gpt2_tiny
+from tpusystem.serve import Engine, Request, Scheduler
+from tpusystem.train import generate
+
+TRIALS = 3
+ROWS = 4
+ON_TPU = jax.default_backend() in ('tpu', 'axon')
+
+
+def recipe():
+    """Model + workload. TPU: the BASELINE decode config (125M). CPU:
+    tiny GPT-2 — smoke numbers, real ratio."""
+    if ON_TPU:
+        module = GPT2(dropout=0.0, vocab_size=50304, max_seq=512)
+        lengths, vocab = (16, 32, 64, 96), 50257
+        budgets = (16, 16, 16, 96) * 3          # short x3 : 1 straggler
+    else:
+        # big enough that a decode step is compute-bound, not dispatch-
+        # bound (the tiny preset hides the batching win behind CPU
+        # per-dispatch overhead — measured 1.2 ms/step static scan vs
+        # 3 ms/step engine dispatch at dim 64)
+        module = gpt2_tiny(dtype='float32', layers=4, dim=256, heads=8,
+                           vocab_size=1024, max_seq=256)
+        lengths, vocab = (4, 8, 16, 24), 1024
+        budgets = (8, 8, 8, 64) * 3
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, (lengths[i % len(lengths)],))
+               .astype(np.int32) for i in range(len(budgets))]
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.asarray(prompts[0][None]))['params']
+    return module, params, prompts, list(budgets)
+
+
+def static_arm(module, params, prompts, budgets) -> tuple[float, int]:
+    """Median wall seconds for the whole workload, padded-batch style,
+    plus delivered tokens. All groups pad prompts to the workload's
+    widest prompt and decode to the group's longest budget."""
+    width = max(len(p) for p in prompts)
+    groups = [slice(i, i + ROWS) for i in range(0, len(prompts), ROWS)]
+
+    def run_once() -> None:
+        for group in groups:
+            batch_prompts = prompts[group]
+            batch_budgets = budgets[group]
+            padded = np.zeros((len(batch_prompts), width), np.int32)
+            for row, prompt in enumerate(batch_prompts):
+                padded[row, :len(prompt)] = prompt
+            out = generate(module, params, jnp.asarray(padded),
+                           steps=max(batch_budgets))
+            materialize(out)
+
+    run_once()                                   # warm/compile
+    trials = []
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        run_once()
+        trials.append(time.perf_counter() - start)
+    return sorted(trials)[len(trials) // 2], sum(budgets)
+
+
+def continuous_arm(module, params, prompts, budgets) -> tuple[float, int, dict]:
+    """Median wall seconds through the paged engine + scheduler, plus
+    delivered tokens and the engine's per-phase dispatch seconds from
+    the LAST trial (fresh counters per trial)."""
+    engine = Engine(module, params, rows=ROWS,
+                    block_size=16 if ON_TPU else 8)
+
+    def run_once() -> dict:
+        engine.timings = {'prefill': 0.0, 'admit': 0.0, 'step': 0.0}
+        scheduler = Scheduler(engine)
+        for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            scheduler.submit(Request(f'r{index}', list(prompt), budget))
+        results = scheduler.run()
+        delivered = sum(len(c.tokens) for c in results.values())
+        assert delivered == sum(budgets), (delivered, sum(budgets))
+        return dict(engine.timings)
+
+    run_once()                                   # warm/compile
+    trials, phases = [], {}
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        phases = run_once()
+        trials.append(time.perf_counter() - start)
+    return sorted(trials)[len(trials) // 2], sum(budgets), phases
+
+
+def main() -> None:
+    module, params, prompts, budgets = recipe()
+    static_seconds, tokens = static_arm(module, params, prompts, budgets)
+    continuous_seconds, _, phases = continuous_arm(module, params, prompts,
+                                                   budgets)
+    static_tok_s = tokens / static_seconds
+    continuous_tok_s = tokens / continuous_seconds
+    workload = (f'{len(prompts)} reqs, prompts '
+                f'{sorted(set(len(p) for p in prompts))}, budgets '
+                f'{sorted(set(budgets))}, rows {ROWS}')
+    print(json.dumps({'metric': 'serve_static_tok_s',
+                      'value': round(static_tok_s, 1), 'unit': 'tok/s',
+                      'seconds': round(static_seconds, 3),
+                      'workload': workload}))
+    for phase, seconds in phases.items():
+        print(json.dumps({'metric': f'serve_phase_{phase}_s',
+                          'value': round(seconds, 4),
+                          'unit': 's (continuous arm, one workload)'}))
+    print(json.dumps({
+        'metric': 'serve_tok_s',
+        'value': round(continuous_tok_s, 1),
+        'unit': f'tok/s delivered ({workload})'
+                + ('' if ON_TPU else ' [CPU smoke]'),
+        'static_tok_s': round(static_tok_s, 1),
+        'speedup_vs_static': round(continuous_tok_s / static_tok_s, 2),
+    }))
+
+
+if __name__ == '__main__':
+    main()        # 'headline' arg tolerated: every section prints anyway

@@ -487,6 +487,55 @@ def test_speculative_quantized_streaming_decodes_in_vocab():
     np.testing.assert_array_equal(out[:, :8], np.asarray(tokens))
 
 
+def test_cursor_authority_is_the_shared_module():
+    """The speculative path and the serving engine must edit cache
+    cursors through ONE implementation (tpusystem.train.cursors) — a
+    private copy in either would let the two drift on which leaves count
+    as cursors or how scanned stacks broadcast."""
+    import importlib
+
+    import tpusystem.serve.engine as serve_engine
+    from tpusystem.train import cursors
+    generate_module = importlib.import_module('tpusystem.train.generate')
+    assert generate_module._rewind is cursors.rewind
+    assert generate_module._gather_rows is cursors.gather_rows
+    assert serve_engine.rewind is cursors.rewind
+
+
+def test_cursors_rewind_and_gather_cover_scanned_and_flat_caches():
+    """Unit pin of the shared authority: rewind broadcasts a [batch]
+    cursor into flat AND layer-stacked cursor leaves (touching nothing
+    else); gather_rows copies KV on the batch axis and cursors on the
+    last axis; read_cursor returns the per-row cursor either way."""
+    import jax.numpy as jnp
+
+    from tpusystem.train import cursors
+    flat = {'h_0': {'attn': {'index': jnp.array([3, 5], jnp.int32),
+                             'key': jnp.arange(2 * 4 * 1 * 1, dtype=jnp.float32)
+                             .reshape(2, 4, 1, 1)}},
+            'position': jnp.array([3, 5], jnp.int32)}
+    rewound = cursors.rewind(flat, jnp.array([1, 2], jnp.int32))
+    np.testing.assert_array_equal(rewound['h_0']['attn']['index'], [1, 2])
+    np.testing.assert_array_equal(rewound['position'], [1, 2])
+    np.testing.assert_array_equal(rewound['h_0']['attn']['key'],
+                                  flat['h_0']['attn']['key'])
+    np.testing.assert_array_equal(cursors.read_cursor(flat), [3, 5])
+
+    stacked = {'hs': {'attn': {'index': jnp.tile(
+        jnp.array([[3, 5]], jnp.int32), (4, 1))}}}   # [layers, batch]
+    rewound = cursors.rewind(stacked, jnp.array([7, 9], jnp.int32))
+    assert rewound['hs']['attn']['index'].shape == (4, 2)
+    np.testing.assert_array_equal(rewound['hs']['attn']['index'][2], [7, 9])
+    np.testing.assert_array_equal(cursors.read_cursor(stacked), [3, 5])
+
+    gathered = cursors.gather_rows(flat, jnp.array([1, 1], jnp.int32))
+    np.testing.assert_array_equal(gathered['h_0']['attn']['index'], [5, 5])
+    np.testing.assert_array_equal(gathered['h_0']['attn']['key'][0],
+                                  flat['h_0']['attn']['key'][1])
+    with pytest.raises(ValueError, match='index'):
+        cursors.read_cursor({'h_0': {'attn': {'key': jnp.zeros((1,))}}})
+
+
 @pytest.mark.slow
 def test_bucketed_cache_attention_crosses_bucket_boundary():
     """max_seq 512 decode buckets cache reads at [256, 512]; a generation

@@ -1,0 +1,468 @@
+"""Continuous-batching serving engine: token-exact under churn.
+
+The engine's contract (tpusystem/serve/): greedy outputs are exactly
+standalone ``generate()``'s for every request REGARDLESS of co-batched
+traffic — admissions, evictions and cancellations of neighbors must not
+change a row's tokens — and batch membership changes never retrace the
+one compiled decode step. Free-list exhaustion queues (never crashes),
+prompt-length bucketing bounds the prefill program count, and the
+request lifecycle narrates on the service bus.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusystem.models import gpt2_tiny, llama_tiny
+from tpusystem.serve import (Engine, InferenceService, PagedKVCache,
+                             Request, Saturated, Scheduler, TRASH_BLOCK,
+                             engine_unsupported_reason, prefill_bucket,
+                             serve_levers)
+from tpusystem.train import generate
+
+
+def reference(module, params, prompt, steps, **kwargs):
+    """Standalone greedy decode of one prompt — the parity oracle."""
+    out = generate(module, params, jnp.asarray(prompt, jnp.int32)[None],
+                   steps=steps, **kwargs)
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+@pytest.fixture(scope='module')
+def served():
+    module = gpt2_tiny(dtype='float32')
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (1, 8)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    return module, params
+
+
+# ---------------------------------------------------------------------------
+# paged pool: free-list + block tables (pure host bookkeeping)
+# ---------------------------------------------------------------------------
+
+
+class TestPagedKVCache:
+    def test_free_list_allocates_and_frees(self):
+        pool = PagedKVCache(rows=2, blocks=9, block_size=4, max_seq=32)
+        assert pool.free_blocks == 8          # block 0 is reserved trash
+        pool.admit(0, tokens=10)              # 3 blocks
+        assert pool.free_blocks == 5
+        assert (pool.table[0, :3] != TRASH_BLOCK).all()
+        assert (pool.table[0, 3:] == TRASH_BLOCK).all()
+        assert pool.evict(0) == 3
+        assert pool.free_blocks == 8
+        assert (pool.table[0] == TRASH_BLOCK).all()
+
+    def test_slots_map_logical_positions_through_the_table(self):
+        pool = PagedKVCache(rows=1, blocks=5, block_size=4, max_seq=16)
+        pool.admit(0, tokens=6)               # blocks for positions 0..7
+        slots = pool.slots(0)
+        first, second = pool.table[0, 0], pool.table[0, 1]
+        np.testing.assert_array_equal(slots[:4], first * 4 + np.arange(4))
+        np.testing.assert_array_equal(slots[4:8], second * 4 + np.arange(4))
+        # unmapped positions land in the trash block
+        assert (slots[8:] < 4).all()
+
+    def test_admission_beyond_free_blocks_raises_and_can_admit_gates(self):
+        pool = PagedKVCache(rows=4, blocks=4, block_size=4, max_seq=32)
+        assert pool.can_admit(12) and not pool.can_admit(13)
+        pool.admit(0, tokens=12)              # all 3 allocatable blocks
+        assert not pool.can_admit(1)
+        with pytest.raises(ValueError, match='free'):
+            pool.admit(1, tokens=4)
+        with pytest.raises(ValueError, match='evict first'):
+            pool.admit(0, tokens=4)
+
+    def test_sequences_never_share_blocks(self):
+        pool = PagedKVCache(rows=3, blocks=10, block_size=4, max_seq=32)
+        for row in range(3):
+            pool.admit(row, tokens=10)
+        owned = pool.table[:, :3]
+        assert len(set(owned.flatten().tolist())) == 9
+
+
+# ---------------------------------------------------------------------------
+# engine scope + capacity validation
+# ---------------------------------------------------------------------------
+
+
+def test_engine_gates_unsupported_modules(served):
+    _, params = served
+    assert engine_unsupported_reason(gpt2_tiny()) is None
+    assert 'scan_layers' in engine_unsupported_reason(
+        gpt2_tiny(scan_layers=True))
+    assert 'MoE' in engine_unsupported_reason(
+        gpt2_tiny(moe_experts=2, moe_every=2))
+    with pytest.raises(ValueError, match='scan_layers'):
+        Engine(gpt2_tiny(scan_layers=True), params)
+
+
+def test_generate_strips_decode_pages_from_its_clone(served):
+    """generate() on a module constructed with decode_pages set must
+    decode through its own contiguous cache (the paged layout needs
+    externally managed tables — only the engine provides them), token-
+    exact with the plain module (found in review: an unstripped field
+    silently aliased every row onto the trash block)."""
+    module, params = served
+    prompt = jnp.asarray(
+        np.random.default_rng(37).integers(0, 256, (2, 6)), jnp.int32)
+    plain = np.asarray(generate(module, params, prompt, steps=6))
+    paged_field = np.asarray(generate(
+        gpt2_tiny(dtype='float32', decode_pages=(16, 8)), params, prompt,
+        steps=6))
+    np.testing.assert_array_equal(paged_field, plain)
+
+
+def test_engine_validates_capacity_and_saturation(served):
+    module, params = served
+    engine = Engine(module, params, rows=1, block_size=8)
+    with pytest.raises(ValueError, match='max_seq'):
+        engine.admit(np.arange(8), max_new=121)    # 8 + 121 > 128
+    with pytest.raises(ValueError, match='max_new'):
+        engine.admit(np.arange(8), max_new=0)
+    engine.admit(np.arange(4) + 1, max_new=4)
+    with pytest.raises(Saturated, match='free row'):
+        engine.admit(np.arange(4) + 1, max_new=4)
+
+
+def test_prefill_bucketing_is_bounded_powers_of_two():
+    assert prefill_bucket(3, 16, 128) == 16       # floor at block_size
+    assert prefill_bucket(17, 16, 128) == 32
+    assert prefill_bucket(33, 16, 128) == 64
+    assert prefill_bucket(100, 16, 128) == 128
+    assert prefill_bucket(128, 16, 128) == 128    # capped at max_seq
+
+
+def test_prefill_compile_count_is_bounded_by_buckets():
+    """A stream of varied prompt lengths compiles one prefill program
+    per BUCKET, not one per length (the round-5 retrace-trap
+    discipline, applied to serving admission)."""
+    from tpusystem.serve import engine as engine_module
+    # a config no other test decodes, so the program-cache delta is ours
+    module = gpt2_tiny(dtype='float32', max_seq=256)
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 8), jnp.int32))['params']
+    engine = Engine(module, params, rows=1, block_size=16)
+    before = engine_module._compiled_prefill.cache_info().currsize
+    for length in (3, 5, 9, 14, 16, 17, 20, 30):   # buckets: 16, 32
+        row = engine.admit(np.arange(length) % 250 + 1, max_new=1)
+        assert row.finished                        # max_new=1: done at admit
+    added = engine_module._compiled_prefill.cache_info().currsize - before
+    assert added == 2, f'{added} prefill programs for 2 buckets'
+
+
+# ---------------------------------------------------------------------------
+# token-exact parity vs standalone generate(), under churn
+# ---------------------------------------------------------------------------
+
+
+def test_engine_single_request_matches_generate(served):
+    module, params = served
+    prompt = np.random.default_rng(3).integers(0, 256, (7,))
+    expected = reference(module, params, prompt, 8)
+    engine = Engine(module, params, rows=2, block_size=8)
+    engine.admit(prompt, max_new=8)
+    tokens = None
+    while engine.active_rows:
+        for _row, reason, out in engine.step().finished:
+            tokens, why = out, reason
+    assert tokens == expected and why == 'length'
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('family', [gpt2_tiny, llama_tiny])
+def test_engine_parity_under_churn(family):
+    """Admit at step k, evict at step m: every request's tokens equal
+    its standalone generate() regardless of co-batched rows — the
+    engine's core contract."""
+    module = family(dtype='float32')
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 256, (n,)) for n in (5, 11, 8, 3)]
+    steps = [14, 6, 10, 9]
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.asarray(prompts[0][None]))['params']
+    expected = [reference(module, params, p, s)
+                for p, s in zip(prompts, steps)]
+
+    engine = Engine(module, params, rows=2, block_size=8)
+    scheduler = Scheduler(engine)
+    # r0+r1 start together; r2 joins mid-stream (free-row churn: r1
+    # finishes first, r2 takes its row); r3 joins after r0 retires
+    scheduler.submit(Request('r0', list(prompts[0]), steps[0]))
+    scheduler.submit(Request('r1', list(prompts[1]), steps[1]))
+    for _ in range(4):
+        scheduler.step()
+    scheduler.submit(Request('r2', list(prompts[2]), steps[2]))
+    for _ in range(6):
+        scheduler.step()
+    scheduler.submit(Request('r3', list(prompts[3]), steps[3]))
+    results = scheduler.run()
+    for index in range(4):
+        got = results[f'r{index}']
+        assert got.tokens == expected[index], f'r{index} diverged'
+        assert got.reason == 'length'
+    assert engine.trace_count == 1
+
+
+def test_compile_guard_one_decode_trace_across_churn(served):
+    """Admission/eviction NEVER retraces the decode step: one trace for
+    the engine's whole life, across row churn and pool recycling."""
+    module, params = served
+    rng = np.random.default_rng(9)
+    engine = Engine(module, params, rows=2, block_size=8)
+    for wave in range(3):
+        engine.admit(rng.integers(0, 256, (4 + wave,)), max_new=3)
+        engine.admit(rng.integers(0, 256, (6,)), max_new=2 + wave)
+        while engine.active_rows:
+            engine.step()
+    assert engine.trace_count == 1, (
+        f'decode step retraced: {engine.trace_count} traces')
+
+
+@pytest.mark.slow
+def test_engine_int8_streaming_matches_generate_int8(served):
+    """The PR-7 serving lever composes: an int8-streaming engine is
+    token-exact against generate(stream_dtype='int8') — dequantization
+    stays inside the one compiled step."""
+    module, params = served
+    prompt = np.random.default_rng(11).integers(0, 256, (9,))
+    expected = reference(module, params, prompt, 10, stream_dtype='int8')
+    engine = Engine(module, params, rows=2, block_size=8,
+                    stream_dtype='int8')
+    engine.admit(prompt, max_new=10)
+    tokens = None
+    while engine.active_rows:
+        for _row, _reason, out in engine.step().finished:
+            tokens = out
+    assert tokens == expected
+
+
+@pytest.mark.slow
+def test_paged_read_crosses_block_bucket_boundary():
+    """A generation whose filled depth crosses the paged read's
+    power-of-2 block-window boundary stays token-exact (the switch picks
+    a wider gather mid-stream — cached_attention's bucket test, paged
+    flavored)."""
+    module = gpt2_tiny(dtype='float32', max_seq=512)
+    prompt = np.random.default_rng(29).integers(0, 256, (250,))
+    params = module.init(jax.random.PRNGKey(1),
+                         jnp.asarray(prompt[None, :8]))['params']
+    expected = reference(module, params, prompt, 20)       # 250 -> 270
+    engine = Engine(module, params, rows=2, block_size=16)
+    engine.admit(prompt, max_new=20)
+    tokens = None
+    while engine.active_rows:
+        for _row, _reason, out in engine.step().finished:
+            tokens = out
+    assert tokens == expected
+
+
+# ---------------------------------------------------------------------------
+# scheduler: exhaustion queues, budgets, cancellation
+# ---------------------------------------------------------------------------
+
+
+def test_free_list_exhaustion_queues_not_crashes(served):
+    """More requests than the pool can seat: the overflow WAITS in the
+    queue and drains in as rows/blocks free — never a crash, never a
+    dropped request."""
+    module, params = served
+    rng = np.random.default_rng(13)
+    # 8 allocatable blocks of 4 = 32 tokens; each request needs 3 blocks
+    engine = Engine(module, params, rows=2, block_size=4, blocks=7)
+    scheduler = Scheduler(engine)
+    prompts = [rng.integers(0, 256, (4,)) for _ in range(5)]
+    for index, prompt in enumerate(prompts):
+        scheduler.submit(Request(f'r{index}', list(prompt), max_new=6))
+    saw_backlog = False
+    for _ in range(200):
+        if scheduler.idle:
+            break
+        tick = scheduler.step()
+        saw_backlog |= tick.queue_depth > 0
+        assert tick.active <= 2
+    assert scheduler.idle, 'queue never drained'
+    assert saw_backlog, 'workload never actually queued — test has no teeth'
+    for index, prompt in enumerate(prompts):
+        assert scheduler.results[f'r{index}'].tokens == reference(
+            module, params, prompt, 6), f'r{index} diverged under backlog'
+
+
+def test_scheduler_refuses_never_fitting_requests(served):
+    module, params = served
+    engine = Engine(module, params, rows=2, block_size=8, blocks=4)
+    scheduler = Scheduler(engine)
+    with pytest.raises(ValueError, match='capacity'):
+        scheduler.submit(Request('big', list(range(1, 100)), max_new=120))
+    with pytest.raises(ValueError, match='blocks'):
+        scheduler.submit(Request('wide', list(range(1, 30)), max_new=10))
+    with pytest.raises(ValueError, match='non-empty'):
+        scheduler.submit(Request('empty', [], max_new=4))
+
+
+def test_prefill_budget_caps_admissions_per_step(served):
+    """The prefill token budget separates phases: a step admits at most
+    budget-worth of (bucket-padded) prompt tokens, so decode latency is
+    bounded even under an admission burst — but one admission always
+    proceeds, so an over-budget prompt cannot starve."""
+    module, params = served
+    rng = np.random.default_rng(17)
+    engine = Engine(module, params, rows=4, block_size=16)
+    scheduler = Scheduler(engine, prefill_budget=16)   # one 16-bucket/step
+    for index in range(3):
+        scheduler.submit(Request(f'r{index}',
+                                 list(rng.integers(0, 256, (5,))),
+                                 max_new=8))
+    assert len(scheduler.step().admitted) == 1         # budget, not rows
+    assert len(scheduler.step().admitted) == 1
+    # a prompt wider than the whole budget still admits (alone)
+    scheduler.submit(Request('wide', list(rng.integers(0, 256, (30,))),
+                             max_new=4))
+    admitted = {request.id
+                for request, _, _ in scheduler.step().admitted}
+    assert admitted == {'r2'}
+    assert {request.id for request, _, _
+            in scheduler.step().admitted} == {'wide'}
+    scheduler.run()
+
+
+def test_cancellation_mid_decode_frees_the_row_and_spares_neighbors(served):
+    """Cancelling an active request evicts it mid-decode (partial tokens
+    kept, reason 'cancelled'), frees its row for the queue, and leaves
+    co-batched rows token-exact."""
+    module, params = served
+    rng = np.random.default_rng(19)
+    keep_prompt = rng.integers(0, 256, (6,))
+    expected = reference(module, params, keep_prompt, 12)
+    engine = Engine(module, params, rows=2, block_size=8)
+    scheduler = Scheduler(engine)
+    scheduler.submit(Request('keep', list(keep_prompt), max_new=12))
+    scheduler.submit(Request('dead', list(rng.integers(0, 256, (5,))),
+                             max_new=12))
+    scheduler.submit(Request('next', list(rng.integers(0, 256, (4,))),
+                             max_new=3))                 # waits for a row
+    scheduler.step()
+    assert scheduler.queue_depth == 1
+    scheduler.step()
+    assert scheduler.cancel('dead') == 'active'
+    cancelled = scheduler.results['dead']
+    assert cancelled.reason == 'cancelled'
+    assert 0 < len(cancelled.tokens) < 12
+    results = scheduler.run()
+    assert results['keep'].tokens == expected
+    assert results['next'].reason == 'length'
+    assert scheduler.cancel('keep') is None              # already done
+
+
+def test_scheduler_tolerates_rows_admitted_directly_on_the_engine(served):
+    """A row seated via engine.admit() (not through the scheduler)
+    retires without a scheduler seat — the scheduler must skip it, not
+    KeyError, and its own queued request must still drain in behind it
+    (found by the verify drive)."""
+    module, params = served
+    rng = np.random.default_rng(41)
+    engine = Engine(module, params, rows=1, block_size=8, blocks=5)
+    engine.admit(rng.integers(0, 256, (5,)), max_new=6)   # foreign row
+    scheduler = Scheduler(engine)
+    scheduler.submit(Request('late', list(rng.integers(0, 256, (4,))),
+                             max_new=5))
+    results = scheduler.run()
+    assert results['late'].reason == 'length'
+    assert len(results['late'].tokens) == 5
+
+
+def test_cancelling_a_queued_request_drops_it(served):
+    module, params = served
+    engine = Engine(module, params, rows=1, block_size=8)
+    scheduler = Scheduler(engine)
+    scheduler.submit(Request('q', [1, 2, 3], max_new=4))
+    assert scheduler.cancel('q') == 'queued'
+    assert scheduler.idle and 'q' not in scheduler.results
+
+
+def test_stop_token_completes_early(served):
+    module, params = served
+    prompt = np.random.default_rng(23).integers(0, 256, (7,))
+    expected = reference(module, params, prompt, 12)
+    stop = expected[3]
+    first_hit = expected.index(stop)                     # tokens repeat
+    engine = Engine(module, params, rows=1, block_size=8)
+    scheduler = Scheduler(engine)
+    scheduler.submit(Request('s', list(prompt), max_new=12,
+                             stop_token=stop))
+    results = scheduler.run()
+    assert results['s'].reason == 'stop'
+    assert results['s'].tokens == expected[:first_hit + 1]  # stop included
+
+
+# ---------------------------------------------------------------------------
+# the bus front door
+# ---------------------------------------------------------------------------
+
+
+def test_service_narrates_the_request_lifecycle(served):
+    from tpusystem.observe.events import (RequestAdmitted, RequestCompleted,
+                                          RequestEvicted, ServeStepped)
+    from tpusystem.services.prodcon import Consumer, Producer
+
+    module, params = served
+    rng = np.random.default_rng(29)
+    witnessed = []
+    consumer = Consumer('probe')
+
+    @consumer.handler
+    def on_serving(event: RequestAdmitted | RequestCompleted
+                   | RequestEvicted | ServeStepped):
+        witnessed.append(event)
+
+    producer = Producer()
+    producer.register(consumer)
+    service = InferenceService(module, params, producer=producer, rows=2,
+                               block_size=8)
+    service.service.handle('submit',
+                           Request('a', list(rng.integers(0, 256, (5,))),
+                                   max_new=4))
+    service.service.handle('submit',
+                           Request('b', list(rng.integers(0, 256, (6,))),
+                                   max_new=20))
+    service.step()
+    service.service.handle('cancel', 'b')
+    service.run_until_idle()
+
+    kinds = {type(event).__name__ for event in witnessed}
+    assert kinds == {'RequestAdmitted', 'RequestCompleted',
+                     'RequestEvicted', 'ServeStepped'}
+    admitted = [e for e in witnessed if isinstance(e, RequestAdmitted)]
+    assert {e.id for e in admitted} == {'a', 'b'}
+    assert all(e.ttft >= 0 for e in admitted)
+    evicted = [e for e in witnessed if isinstance(e, RequestEvicted)]
+    assert evicted[0].id == 'b' and evicted[0].reason == 'cancelled'
+    completed = [e for e in witnessed if isinstance(e, RequestCompleted)]
+    assert completed[0].id == 'a' and completed[0].reason == 'length'
+    stepped = [e for e in witnessed if isinstance(e, ServeStepped)]
+    assert stepped[-1].queue_depth == 0 and stepped[-1].active == 0
+
+
+def test_tensorboard_serve_handlers_chart_the_events(tmp_path):
+    from tpusystem.observe.events import RequestAdmitted, ServeStepped
+    from tpusystem.observe.tensorboard import (SummaryWriter,
+                                               tensorboard_consumer, writer)
+
+    consumer = tensorboard_consumer()
+    board = SummaryWriter(tmp_path)
+    consumer.dependency_overrides[writer] = lambda: board
+    consumer.consume(RequestAdmitted(id='r', row=0, prompt_tokens=5,
+                                     ttft=0.01, queue_depth=2))
+    consumer.consume(ServeStepped(step=3, active=2, queue_depth=1,
+                                  emitted=2, tokens_per_sec=123.4))
+    board.flush()
+    events = list(tmp_path.glob('events.out.tfevents.*'))
+    assert events and events[0].stat().st_size > 120
+
+
+def test_serve_levers_pick_the_backend_default():
+    levers = serve_levers()
+    assert levers['stream_dtype'] == (
+        'int8' if jax.default_backend() in ('tpu', 'axon') else 'auto')

@@ -57,6 +57,9 @@ class SelfAttention(nn.Module):
     decode: bool = False   # KV-cache incremental decoding (xla kernel only)
     max_seq: int = 1024    # cache capacity when decoding
     per_row_decode: bool = False  # per-row cache cursors (speculative decoding)
+    decode_pages: tuple | None = None  # (num_blocks, block_size): paged
+    # block-pool KV cache with per-row block tables (the serving engine's
+    # layout — ops.attention.paged_attention)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -78,7 +81,8 @@ class SelfAttention(nn.Module):
         if self.decode:
             from tpusystem.ops.attention import cached_attention
             context = cached_attention(self, query, key, value, self.max_seq,
-                                       per_row=self.per_row_decode)
+                                       per_row=self.per_row_decode,
+                                       pages=self.decode_pages)
         else:
             dropout = attn_dropout if train else 0.0
             context = attend(
@@ -104,6 +108,7 @@ class Block(nn.Module):
     decode: bool = False
     max_seq: int = 1024
     per_row_decode: bool = False
+    decode_pages: tuple | None = None  # paged KV pool (see SelfAttention)
     moe_experts: int = 0
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
@@ -131,6 +136,7 @@ class Block(nn.Module):
                                  attn_dropout=self.attn_dropout,
                                  decode=self.decode, max_seq=self.max_seq,
                                  per_row_decode=self.per_row_decode,
+                                 decode_pages=self.decode_pages,
                                  name='attn')(
             normed.astype(self.dtype), train)
         attended = nn.Dropout(self.dropout, deterministic=not train)(attended)
@@ -218,6 +224,7 @@ class BlockSpan(nn.Module):
     decode: bool = False
     max_seq: int = 1024
     per_row_decode: bool = False
+    decode_pages: tuple | None = None  # paged KV pool (see SelfAttention)
     moe_experts: int = 0
     moe_every: int = 2
     moe_k: int = 2
@@ -235,6 +242,7 @@ class BlockSpan(nn.Module):
                       attn_dropout=self.attn_dropout, decode=self.decode,
                       max_seq=self.max_seq,
                       per_row_decode=self.per_row_decode,
+                      decode_pages=self.decode_pages,
                       tp_impl=self.tp_impl, tp_chunks=self.tp_chunks,
                       schedule=self.schedule)
         if self.moe_experts and self.span % self.moe_every:
@@ -300,6 +308,11 @@ class GPT2(nn.Module):
     # 2D gather-index scatter so rows advance independently (speculative
     # decoding); False keeps ordinary decode on the faster
     # dynamic_update_slice at the shared cursor
+    decode_pages: tuple | None = None  # (num_blocks, block_size): paged
+    # block-pool KV cache with per-row block tables — the serving
+    # engine's layout (tpusystem.serve; ops.attention.paged_attention).
+    # Implies per-row cursors; admission/eviction are host-side table
+    # edits, never a cache reshape
     moe_experts: int = 0  # >0: MoE FFN in every `moe_every`-th block
     moe_every: int = 2
     moe_k: int = 2
@@ -356,6 +369,7 @@ class GPT2(nn.Module):
                           attn_dropout=self.attn_dropout,
                           decode=self.decode, max_seq=self.max_seq,
                           per_row_decode=self.per_row_decode,
+                          decode_pages=self.decode_pages,
                           tp_impl=self.tp_impl, tp_chunks=self.tp_chunks,
                           schedule=self.schedule)
             from tpusystem.parallel.mesh import scan_carry_constraint
@@ -429,6 +443,7 @@ class GPT2(nn.Module):
                                   attn_dropout=self.attn_dropout,
                                   decode=self.decode, max_seq=self.max_seq,
                                   per_row_decode=self.per_row_decode,
+                                  decode_pages=self.decode_pages,
                                   moe_experts=self.moe_experts if is_moe else 0,
                                   moe_k=self.moe_k,
                                   moe_capacity_factor=self.moe_capacity_factor,

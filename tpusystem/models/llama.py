@@ -104,6 +104,9 @@ class LlamaAttention(nn.Module):
     decode: bool = False
     max_seq: int = 8192
     per_row_decode: bool = False  # per-row cache cursors (speculative decoding)
+    decode_pages: tuple | None = None  # (num_blocks, block_size): paged
+    # block-pool KV cache with per-row block tables (the serving engine's
+    # layout — ops.attention.paged_attention)
 
     @nn.compact
     def __call__(self, hidden, train: bool = False):
@@ -136,7 +139,8 @@ class LlamaAttention(nn.Module):
         if self.decode:
             from tpusystem.ops.attention import cached_attention
             context = cached_attention(self, query, key, value, self.max_seq,
-                                       per_row=self.per_row_decode)
+                                       per_row=self.per_row_decode,
+                                       pages=self.decode_pages)
         else:
             context = attend(query, key, value, kernel=self.kernel,
                              mesh=self.mesh, causal=True)
@@ -157,6 +161,7 @@ class LlamaBlock(nn.Module):
     decode: bool = False
     max_seq: int = 8192
     per_row_decode: bool = False
+    decode_pages: tuple | None = None  # paged KV pool (see LlamaAttention)
     tp_impl: str = 'gspmd'  # SwiGLU TP collectives: 'gspmd' | 'overlap'
     tp_chunks: int = 1
     schedule: object = None  # parallel.OverlapSchedule composing TP rings
@@ -175,6 +180,7 @@ class LlamaBlock(nn.Module):
             self.heads, self.kv_heads, self.dtype, self.rope_theta,
             kernel=self.attention, mesh=self.mesh, decode=self.decode,
             max_seq=self.max_seq, per_row_decode=self.per_row_decode,
+            decode_pages=self.decode_pages,
             name='attn')(normed, train)
         normed = RMSNorm(name='ffn_norm')(hidden)
         from tpusystem.parallel.overlap import DenseParams
@@ -230,6 +236,7 @@ class LlamaBlockSpan(nn.Module):
     decode: bool = False
     max_seq: int = 8192
     per_row_decode: bool = False
+    decode_pages: tuple | None = None  # paged KV pool (see LlamaAttention)
     tp_impl: str = 'gspmd'
     tp_chunks: int = 1
     schedule: object = None  # OverlapSchedule (see LlamaBlock.schedule)
@@ -242,6 +249,7 @@ class LlamaBlockSpan(nn.Module):
                                 attention=self.attention, mesh=self.mesh,
                                 decode=self.decode, max_seq=self.max_seq,
                                 per_row_decode=self.per_row_decode,
+                                decode_pages=self.decode_pages,
                                 tp_impl=self.tp_impl,
                                 tp_chunks=self.tp_chunks,
                                 schedule=self.schedule,
@@ -285,6 +293,9 @@ class Llama(nn.Module):
     per_row_decode: bool = False  # per-row cache cursors for speculative
     # decoding (scatter writes); False = ordinary decode, shared-cursor
     # dynamic_update_slice cache writes
+    decode_pages: tuple | None = None  # (num_blocks, block_size): paged
+    # block-pool KV cache with per-row block tables — the serving
+    # engine's layout (tpusystem.serve; ops.attention.paged_attention)
     tp_impl: str = 'gspmd'  # SwiGLU TP collectives: 'gspmd' (monolithic
     # partitioner-inserted all-gather/reduce-scatter) | 'overlap'
     # (decomposed latency-hiding ring matmuls — parallel/overlap.py;
@@ -324,6 +335,7 @@ class Llama(nn.Module):
                                     mesh=self.mesh, decode=self.decode,
                                     max_seq=self.max_seq,
                                     per_row_decode=self.per_row_decode,
+                                    decode_pages=self.decode_pages,
                                     tp_impl=self.tp_impl,
                                     tp_chunks=self.tp_chunks,
                                     schedule=self.schedule,
@@ -337,6 +349,7 @@ class Llama(nn.Module):
                                      mesh=self.mesh, decode=self.decode,
                                      max_seq=self.max_seq,
                                      per_row_decode=self.per_row_decode,
+                                     decode_pages=self.decode_pages,
                                      tp_impl=self.tp_impl,
                                      tp_chunks=self.tp_chunks,
                                      schedule=self.schedule,
@@ -358,6 +371,7 @@ class Llama(nn.Module):
                                    attention=self.attention, mesh=self.mesh,
                                    decode=self.decode, max_seq=self.max_seq,
                                    per_row_decode=self.per_row_decode,
+                                   decode_pages=self.decode_pages,
                                    tp_impl=self.tp_impl,
                                    tp_chunks=self.tp_chunks,
                                    schedule=self.schedule,

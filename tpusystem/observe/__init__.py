@@ -13,7 +13,9 @@ has exactly one device→host sync per phase (``metrics.compute()``).
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
                                       Iterated, RecoveryTimeline,
-                                      ReplicaDiverged, RolledBack, StepTimed,
+                                      ReplicaDiverged, RequestAdmitted,
+                                      RequestCompleted, RequestEvicted,
+                                      RolledBack, ServeStepped, StepTimed,
                                       Trained, Validated, WorkerExited,
                                       WorkerRelaunched)
 from tpusystem.observe.ledger import EventLedger, LedgerDivergence
@@ -29,6 +31,7 @@ __all__ = [
     'Trained', 'Validated', 'Iterated', 'StepTimed',
     'AnomalyDetected', 'BackoffApplied', 'RolledBack', 'ReplicaDiverged',
     'WorkerExited', 'WorkerRelaunched', 'RecoveryTimeline',
+    'RequestAdmitted', 'RequestEvicted', 'RequestCompleted', 'ServeStepped',
     'logging_consumer', 'SummaryWriter', 'tensorboard_consumer',
     'tracking_consumer', 'checkpoint_consumer', 'experiment',
     'metrics_store', 'models_store',

@@ -98,6 +98,55 @@ class ReplicaDiverged:
 
 
 # --------------------------------------------------------------------------
+# serving events — the continuous-batching engine's request lifecycle
+# (tpusystem.serve): every admission, eviction and completion is a domain
+# event on the bus, so the ledger orders a serving incident and
+# TensorBoard charts queue depth / time-to-first-token / tokens-per-sec
+# without the engine knowing its observers.
+
+
+@event
+class RequestAdmitted:
+    """A queued request was prefilled and seated in an engine row;
+    ``ttft`` is submit -> first token (time-to-first-token), seconds."""
+    id: str
+    row: int
+    prompt_tokens: int
+    ttft: float
+    queue_depth: int
+
+
+@event
+class RequestEvicted:
+    """A request left its row before finishing (``reason`` =
+    ``'cancelled'``); ``produced`` tokens were emitted by then."""
+    id: str
+    produced: int
+    reason: str
+
+
+@event
+class RequestCompleted:
+    """A request finished (``reason`` = ``'length'`` | ``'stop'``) and
+    its row/blocks returned to the free lists."""
+    id: str
+    produced: int
+    reason: str
+    seconds: float
+
+
+@event
+class ServeStepped:
+    """One scheduler iteration: current batch occupancy and queue depth,
+    plus the sliding tokens-per-second the engine is sustaining."""
+    step: int
+    active: int
+    queue_depth: int
+    emitted: int
+    tokens_per_sec: float
+
+
+# --------------------------------------------------------------------------
 # supervisor events — the recovery control loop
 # (tpusystem.parallel.supervisor) narrates every worker exit, relaunch and
 # recovery through the bus, so the ledger orders a whole incident and

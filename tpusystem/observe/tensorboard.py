@@ -28,7 +28,8 @@ import time
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
                                       RecoveryTimeline, ReplicaDiverged,
-                                      RolledBack, Trained, Validated,
+                                      RequestAdmitted, RolledBack,
+                                      ServeStepped, Trained, Validated,
                                       WorkerExited)
 from tpusystem.services.prodcon import Consumer, Depends
 
@@ -208,6 +209,29 @@ def tensorboard_consumer() -> Consumer:
         exit_counts[event.rank] = exit_counts.get(event.rank, 0) + 1
         board.add_scalar(f'supervisor/rank{event.rank}/exit_code',
                          float(event.code), exit_counts[event.rank])
+
+    # serving engine: queue depth and throughput per scheduler step, and
+    # time-to-first-token per admission (charted against an admission
+    # counter — requests have no global step), so a latency or backlog
+    # incident reads straight off the dashboard
+    admit_counts = [0]
+
+    @consumer.handler
+    def on_request_admitted(event: RequestAdmitted,
+                            board: SummaryWriter = Depends(writer)) -> None:
+        admit_counts[0] += 1
+        board.add_scalar('serve/ttft_seconds', event.ttft, admit_counts[0])
+        board.add_scalar('serve/queue_depth_at_admit',
+                         float(event.queue_depth), admit_counts[0])
+
+    @consumer.handler
+    def on_serve_stepped(event: ServeStepped,
+                         board: SummaryWriter = Depends(writer)) -> None:
+        board.add_scalar('serve/queue_depth', float(event.queue_depth),
+                         event.step)
+        board.add_scalar('serve/active_rows', float(event.active),
+                         event.step)
+        board.add_scalar('serve/tok_s', event.tokens_per_sec, event.step)
 
     @consumer.handler
     def on_recovery(event: RecoveryTimeline,

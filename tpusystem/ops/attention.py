@@ -112,8 +112,111 @@ def _assert_uniform_cursor(cursor):
             'managed or speculative cursor state')
 
 
+def paged_attention(module, query, key, value, max_seq: int,
+                    pages: tuple[int, int]):
+    """Incremental attention over a **paged** KV cache (block pool +
+    per-row block tables) — the serving engine's layout
+    (:mod:`tpusystem.serve`, vLLM's PagedAttention block-table idea on
+    the :func:`cached_attention` machinery).
+
+    ``pages = (num_blocks, block_size)``. Instead of each row owning a
+    contiguous ``[max_seq, heads, head_dim]`` strip, the cache is one
+    shared pool of ``num_blocks`` blocks of ``block_size`` tokens
+    (``'key'``/``'value'`` cache variables, flattened to
+    ``[num_blocks * block_size, kv_heads, head_dim]``), and each row
+    maps its *logical* block ``j`` (tokens ``j*block_size ...``) to a
+    physical block through a ``'table'`` cache variable
+    (``[batch, max_seq // block_size]`` int32). A sequence's cache can
+    then live in non-contiguous blocks, and batch-row membership changes
+    are host-side table edits plus block writes — never a reshape of the
+    pool, so the engine's decode program compiles once.
+
+    Contract (owned by :class:`tpusystem.serve.Engine`): physical block
+    0 is the **trash block** — every unmapped table entry points there,
+    so retired rows' dead writes land in trash instead of a live row's
+    blocks; distinct live rows never share a physical block; the table
+    rows for a sequence are populated (host-side) before its cursor
+    advances into them. Cursors are inherently per-row (the ``index``
+    cursor leaf is the same ``[batch]`` int32 the contiguous per-row
+    path uses, so :mod:`tpusystem.train.cursors` edits apply
+    unchanged).
+
+    Reads are bucketed like the contiguous path, in block units: the
+    smallest power-of-2 block window covering the deepest filled row is
+    gathered from the pool (``lax.switch`` over static widths — one
+    compiled program, capacity-independent read cost), masked at each
+    row's own depth. Masked positions contribute exact zeros, so a row's
+    output is independent of its co-batched traffic in
+    window-length-invariant arithmetic (f32; the same caveat as
+    speculative verify applies at the TPU MXU's default precision).
+    """
+    num_blocks, block = pages
+    if max_seq % block:
+        raise ValueError(f'max_seq ({max_seq}) must be a multiple of the '
+                         f'page block_size ({block})')
+    batch, length, kv_heads, head_dim = key.shape
+    max_blocks = max_seq // block
+    pool_shape = (num_blocks * block, kv_heads, head_dim)
+    cache_key = module.variable('cache', 'key', jnp.zeros, pool_shape,
+                                key.dtype)
+    cache_value = module.variable('cache', 'value', jnp.zeros, pool_shape,
+                                  value.dtype)
+    table = module.variable('cache', 'table', jnp.zeros,
+                            (batch, max_blocks), jnp.int32)
+    index = module.variable('cache', 'index',
+                            lambda: jnp.zeros((batch,), jnp.int32))
+    if module.is_initializing():
+        return dot_product_attention(query, key, value, causal=True)
+    cursor = index.value                                        # [batch]
+    positions = cursor[:, None] + jnp.arange(length)[None, :]   # [B, L]
+    # physical token slot of each logical position, through the table;
+    # past-capacity positions clamp onto the last table column — the
+    # engine keeps those columns unmapped (trash), so overflow writes
+    # are dead, never corrupting (the generate() capacity contract)
+    logical = jnp.minimum(positions // block, max_blocks - 1)
+    physical = jnp.take_along_axis(table.value, logical, axis=1)
+    slots = (physical * block + positions % block).reshape(-1)  # [B*L]
+    cache_key.value = cache_key.value.at[slots].set(
+        key.reshape(-1, kv_heads, head_dim).astype(cache_key.value.dtype))
+    cache_value.value = cache_value.value.at[slots].set(
+        value.reshape(-1, kv_heads, head_dim).astype(cache_value.value.dtype))
+    index.value = cursor + length
+
+    # bucketed block-window read: gather the first `width` table columns'
+    # blocks and mask at each row's logical depth — the cached_attention
+    # bucket discipline, in block units (same starting point: the
+    # smallest window is ~256 tokens, or the whole table when smaller)
+    def attend_over(width: int):
+        def run():
+            mapped = jax.lax.slice_in_dim(table.value, 0, width, axis=1)
+            tokens = (mapped[:, :, None] * block
+                      + jnp.arange(block)[None, None, :]
+                      ).reshape(batch, width * block)
+            keys = jnp.take(cache_key.value, tokens, axis=0)
+            values = jnp.take(cache_value.value, tokens, axis=0)
+            mask = (jnp.arange(width * block)[None, None, :]
+                    <= positions[:, :, None])                  # [B, L, W]
+            return dot_product_attention(query, keys, values,
+                                         causal=False, mask=mask[:, None])
+        return run
+
+    # the contiguous path starts its buckets at 256 tokens (a slice is
+    # nearly free, so fine-grained switching buys little); the paged
+    # read is a GATHER whose cost is proportional to the window, so it
+    # starts at 64 tokens — shallow rows read 4x less pool
+    buckets = [min(max_blocks, max(1, 64 // block))]
+    while buckets[-1] < max_blocks:
+        buckets.append(min(2 * buckets[-1], max_blocks))
+    if len(buckets) == 1:
+        return attend_over(max_blocks)()
+    filled_blocks = (jnp.max(positions) + block) // block
+    bucket_index = sum((filled_blocks > width).astype(jnp.int32)
+                       for width in buckets[:-1])
+    return jax.lax.switch(bucket_index, [attend_over(w) for w in buckets])
+
+
 def cached_attention(module, query, key, value, max_seq: int,
-                     per_row: bool = False):
+                     per_row: bool = False, pages: tuple | None = None):
     """Incremental (KV-cache) attention for autoregressive decoding.
 
     Called from inside a flax module in decode mode: maintains
@@ -149,7 +252,13 @@ def cached_attention(module, query, key, value, max_seq: int,
     async) as a callback-failure ``XlaRuntimeError`` at the next sync
     whose log carries the message. Debug-only — it forces a per-step
     host transfer.
+
+    ``pages=(num_blocks, block_size)`` switches the cache to the paged
+    block-pool layout (:func:`paged_attention` — the serving engine's
+    non-contiguous per-row storage; implies per-row cursors).
     """
+    if pages is not None:
+        return paged_attention(module, query, key, value, max_seq, pages)
     batch, length, kv_heads, head_dim = key.shape
     if length > max_seq:
         # static shapes let this raise at trace time; per-step overflow

@@ -1,0 +1,38 @@
+"""Serving tier: a continuous-batching inference engine on the bus.
+
+The single biggest step from "can train at scale" to "can serve
+millions of users" (ROADMAP item 1): a decode program that compiles
+**once** and whose batch membership changes every step without
+retracing. Orca's iteration-level scheduling and vLLM's PagedAttention
+block-table KV management, built on machinery this repo already had —
+per-row decode cursors (:mod:`tpusystem.train.cursors`), bucketed
+cache attention (:func:`tpusystem.ops.attention.paged_attention`), and
+the PR-7 weight-streaming levers.
+
+Layers, bottom up:
+
+* :class:`PagedKVCache` (+ ``adopt_prefill`` / ``write_tables``) — the
+  block pool free-list and per-sequence block tables
+  (:mod:`tpusystem.serve.kvcache`);
+* :class:`Engine` — the fixed-shape compiled decode step with
+  admit/evict row churn (:mod:`tpusystem.serve.engine`);
+* :class:`Scheduler` / :class:`Request` — prefill/decode phase packing
+  under a token budget (:mod:`tpusystem.serve.scheduler`);
+* :class:`InferenceService` — the command/event bus front door
+  (:mod:`tpusystem.serve.service`).
+"""
+
+from tpusystem.serve.engine import (Admission, Engine, Saturated,
+                                    StepReport, engine_unsupported_reason,
+                                    prefill_bucket)
+from tpusystem.serve.kvcache import (TRASH_BLOCK, PagedKVCache,
+                                     adopt_prefill, write_tables)
+from tpusystem.serve.scheduler import (Completion, Request, Scheduler,
+                                       Tick, serve_levers)
+from tpusystem.serve.service import InferenceService
+
+__all__ = ['Engine', 'Admission', 'StepReport', 'Saturated',
+           'engine_unsupported_reason', 'prefill_bucket',
+           'PagedKVCache', 'TRASH_BLOCK', 'adopt_prefill', 'write_tables',
+           'Scheduler', 'Request', 'Completion', 'Tick', 'serve_levers',
+           'InferenceService']

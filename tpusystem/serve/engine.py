@@ -1,0 +1,338 @@
+"""Continuous-batching decode engine: one compiled step, churning rows.
+
+The engine runs a fixed-shape ``[rows, 1]`` greedy token-step under
+``jit`` — the ``per_row_decode`` discipline from the speculative path
+(:mod:`tpusystem.train.generate`), extended to independent user
+sequences over the paged KV cache
+(:func:`tpusystem.ops.attention.paged_attention`). Batch membership
+changes every step **without retracing**:
+
+* **admit** — the prompt prefills through a plain contiguous decode
+  apply (one compiled prefill program per pad bucket —
+  :func:`prefill_bucket`), the resulting KV strip scatters into
+  free-list blocks (:func:`tpusystem.serve.kvcache.adopt_prefill`), and
+  the row's block table and cursor are edited host-side. The prefill
+  logits' argmax is the request's first token.
+* **step** — every row advances one token in one dispatch; retired rows
+  idle at the trash block behind an active mask.
+* **evict** — blocks return to the free list and the row's table resets
+  to trash; the decode program never sees a shape change.
+
+Greedy outputs are **token-exact against standalone**
+:func:`tpusystem.train.generate.generate` for every request, regardless
+of co-batched traffic, in window-length-invariant arithmetic (f32
+modules; masked attention positions contribute exact zeros, so a row
+never observes its neighbors — pinned by ``tests/test_serve.py``).
+
+``stream_dtype`` applies :func:`generate`'s weight-streaming levers to
+the engine's param tree ('int8' halves the per-step streamed weight
+bytes vs bf16; dequantization stays inside the compiled step so the
+narrow leaves remain the HBM-resident operand).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusystem.serve.kvcache import (PagedKVCache, adopt_prefill,
+                                     write_tables)
+from tpusystem.train.cursors import read_cursor, rewind
+from tpusystem.train.generate import _decoder, _dequant, _stream_params
+
+
+class Saturated(RuntimeError):
+    """No free row or not enough free blocks — the request must stay
+    queued (the scheduler's job), never crash the engine."""
+
+
+def engine_unsupported_reason(module) -> str | None:
+    """None when the paged engine can serve this module, else why not
+    (the ``fused_unsupported_reason`` capability-gate discipline)."""
+    for field in ('decode', 'max_seq', 'per_row_decode', 'decode_pages'):
+        if not hasattr(module, field):
+            return (f'module {type(module).__name__} has no {field!r} '
+                    'field — the engine needs the family decode '
+                    'conventions (GPT2 / Llama)')
+    if getattr(module, 'scan_layers', False):
+        return ('scan_layers stacks the per-layer caches at a leading '
+                'layer dim; the engine admission writes are unrolled-'
+                'layout only — serve the unrolled module')
+    if getattr(module, 'moe_experts', 0):
+        return ('MoE expert capacity derives from the step\'s batch '
+                'token count, so a shared-batch decode step is not '
+                'token-exact against per-request decode')
+    return None
+
+
+def prefill_bucket(length: int, block_size: int, max_seq: int) -> int:
+    """Pad-to-bucket width for a prompt: the smallest power-of-2 at
+    least ``max(length, block_size)``, capped at ``max_seq`` — so a
+    stream of varied prompt lengths compiles a **bounded** set of
+    prefill programs (the retrace-trap discipline) instead of one per
+    length."""
+    bucket = max(length, block_size)
+    bucket = 1 << (bucket - 1).bit_length()
+    return min(bucket, max_seq)
+
+
+@functools.cache
+def _compiled_prefill(decoder, bucket: int):
+    """One compiled prefill program per (decode clone, pad bucket) —
+    ``cache_info()`` is the compile-count witness the bucketing tests
+    pin."""
+    return _build_prefill(decoder, bucket)
+
+
+def _build_prefill(decoder, bucket: int):
+    del bucket          # part of the cache key; shapes key the jit cache
+
+    @jax.jit
+    def run(params, padded, length):
+        # plain contiguous prefill: one causal pass over the padded
+        # prompt builds every layer's [1, max_seq, ...] KV strip; the
+        # right-pad junk is causally invisible to the real positions
+        logits, state = decoder.apply(
+            {'params': _dequant(params, decoder)}, padded,
+            mutable=['cache'])
+        first = jnp.argmax(logits[0, length - 1], axis=-1).astype(jnp.int32)
+        return first, state['cache']
+
+    return run
+
+
+@dataclasses.dataclass
+class Admission:
+    """What :meth:`Engine.admit` hands back: the row the request landed
+    in, its first token (from the prefill logits), and whether that
+    token already completed it (``max_new == 1`` or a stop hit)."""
+    row: int
+    token: int
+    finished: bool
+    reason: str | None = None       # 'length' | 'stop' when finished
+
+
+@dataclasses.dataclass
+class StepReport:
+    """One engine step: ``emitted`` maps row -> new token for every row
+    that was active, ``finished`` lists the rows retired this step —
+    ``(row, reason, tokens)`` triples, already evicted by the time the
+    report returns (the tokens ride out with the report because eviction
+    frees the row's state)."""
+    emitted: dict
+    finished: list                   # [(row, reason, tokens), ...]
+
+
+@dataclasses.dataclass
+class _RowState:
+    tokens: list
+    max_new: int
+    stop: int | None
+    tag: object = None               # opaque caller handle (request id)
+
+
+class Engine:
+    """The continuous-batching engine over one model's param tree.
+
+    Args:
+        module: a family LM module (GPT2 / Llama conventions; see
+            :func:`engine_unsupported_reason` for the scope gate).
+        params: trained parameters.
+        rows: fixed decode batch width — the compiled step's shape.
+        block_size: tokens per KV block.
+        blocks: physical blocks in the pool (including the reserved
+            trash block 0). Default sizes the pool to back every row at
+            full ``max_seq`` depth; smaller pools oversubscribe capacity
+            and rely on the scheduler to queue.
+        stream_dtype: :func:`tpusystem.train.generate.generate`'s
+            weight-streaming lever, applied to the engine's param tree
+            ('int8' for the serving default on HBM-bound chips).
+
+    The decode step traces exactly once per engine (``trace_count`` is
+    the witness); admissions and evictions are host-side table edits
+    plus fixed-shape device writes.
+    """
+
+    def __init__(self, module, params, *, rows: int = 4,
+                 block_size: int = 16, blocks: int | None = None,
+                 stream_dtype: str = 'auto') -> None:
+        reason = engine_unsupported_reason(module)
+        if reason is not None:
+            raise ValueError(f'the serving engine cannot run this module: '
+                             f'{reason}')
+        self.rows, self.block_size = rows, block_size
+        self.max_seq = module.max_seq
+        if blocks is None:
+            blocks = rows * (self.max_seq // block_size) + 1
+        self.stream_dtype = stream_dtype
+        self._prefiller = _decoder(module)     # contiguous, shared-cursor
+        self._decoder = dataclasses.replace(
+            _decoder(module, per_row=True),
+            decode_pages=(blocks, block_size))
+        self._params = _stream_params(self._decoder, params, stream_dtype)
+        self.pool = PagedKVCache(rows, blocks, block_size, self.max_seq)
+        shapes = jax.eval_shape(
+            functools.partial(self._decoder.init, jax.random.PRNGKey(0)),
+            jnp.zeros((rows, 1), jnp.int32))['cache']
+        self._cache = jax.tree.map(
+            lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), shapes)
+        self._free_rows = list(range(rows - 1, -1, -1))
+        # host mirrors for bookkeeping; the device copies are what the
+        # step consumes (tokens feed back device-to-device — the per-
+        # step host round trip is ONLY the emitted-token read)
+        self._tokens = np.zeros(rows, np.int32)
+        self._active = np.zeros(rows, bool)
+        self._tokens_dev = jnp.zeros(rows, jnp.int32)
+        self._active_dev = jnp.zeros(rows, bool)
+        self._rowstate: dict[int, _RowState] = {}
+        self._prefills: dict[int, object] = {}   # unhashable-module path
+        self.trace_count = 0
+        self.timings = {'prefill': 0.0, 'admit': 0.0, 'step': 0.0}
+
+        def step_fn(params, cache, tokens, active):
+            self.trace_count += 1            # runs at trace time only
+            logits, updated = self._decoder.apply(
+                {'params': _dequant(params, self._decoder), 'cache': cache},
+                tokens[:, None], mutable=['cache'])
+            token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            # park retired rows' cursors at 0 so their dead writes stay
+            # in the trash block's first slots instead of walking off the
+            # table; active rows keep the cursor cached_attention advanced
+            cursor = read_cursor(cache)
+            return token, rewind(updated['cache'],
+                                 jnp.where(active, cursor + 1, 0))
+
+        self._step = jax.jit(step_fn, donate_argnums=(1,))
+
+    # ------------------------------------------------------------ admission
+
+    @property
+    def free_rows(self) -> int:
+        return len(self._free_rows)
+
+    @property
+    def active_rows(self) -> int:
+        return int(self._active.sum())
+
+    def can_admit(self, prompt_len: int, max_new: int) -> bool:
+        return (bool(self._free_rows)
+                and self.pool.can_admit(prompt_len + max_new))
+
+    def bucket(self, prompt_len: int) -> int:
+        return prefill_bucket(prompt_len, self.block_size, self.max_seq)
+
+    def admit(self, prompt, max_new: int, *, stop_token: int | None = None,
+              tag=None) -> Admission:
+        """Prefill ``prompt`` and seat it in a free row. Raises
+        :class:`Saturated` when no row or not enough blocks are free
+        (the scheduler queues on this), ``ValueError`` on requests that
+        could never fit."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError('empty prompt')
+        if max_new < 1:
+            raise ValueError(f'max_new must be >= 1, got {max_new}')
+        if prompt.size + max_new > self.max_seq:
+            raise ValueError(
+                f'prompt ({prompt.size}) + max_new ({max_new}) exceeds the '
+                f'cache capacity max_seq={self.max_seq}')
+        if not self._free_rows:
+            raise Saturated('no free row')
+        if not self.pool.can_admit(prompt.size + max_new):
+            raise Saturated(
+                f'{self.pool.blocks_for(prompt.size + max_new)} blocks '
+                f'needed, {self.pool.free_blocks} free')
+
+        bucket = self.bucket(prompt.size)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :prompt.size] = prompt
+        started = time.perf_counter()
+        try:
+            run = _compiled_prefill(self._prefiller, bucket)
+        except TypeError:        # unhashable module field (e.g. live mesh)
+            run = self._prefills.setdefault(
+                bucket, _build_prefill(self._prefiller, bucket))
+        first, prefill_cache = run(self._params, jnp.asarray(padded),
+                                   prompt.size)
+        first = int(first)
+        self.timings['prefill'] += time.perf_counter() - started
+
+        started = time.perf_counter()
+        row = self._free_rows.pop()
+        slots = self.pool.admit(row, prompt.size + max_new)
+        self._cache = adopt_prefill(self._cache, prefill_cache,
+                                    jnp.asarray(slots), row, prompt.size)
+        self._cache = write_tables(self._cache, self.pool.table)
+        self.timings['admit'] += time.perf_counter() - started
+
+        self._tokens[row] = first
+        self._active[row] = True
+        self._tokens_dev = self._tokens_dev.at[row].set(first)
+        self._active_dev = self._active_dev.at[row].set(True)
+        self._rowstate[row] = _RowState(tokens=[first], max_new=max_new,
+                                        stop=stop_token, tag=tag)
+        reason = self._finish_reason(row)
+        if reason is not None:
+            self.evict(row)
+            return Admission(row, first, True, reason)
+        return Admission(row, first, False)
+
+    def _finish_reason(self, row: int) -> str | None:
+        state = self._rowstate[row]
+        if state.stop is not None and state.tokens[-1] == state.stop:
+            return 'stop'
+        if len(state.tokens) >= state.max_new:
+            return 'length'
+        return None
+
+    # ------------------------------------------------------------- decoding
+
+    def step(self) -> StepReport:
+        """Advance every active row by one greedy token (one fixed-shape
+        dispatch), retire rows that hit their length or stop token."""
+        if not self._active.any():
+            return StepReport({}, [])
+        started = time.perf_counter()
+        token_dev, self._cache = self._step(self._params, self._cache,
+                                            self._tokens_dev,
+                                            self._active_dev)
+        token = np.asarray(token_dev)
+        # retired rows' stale device token stays as-is (in-vocab junk an
+        # inactive row may keep embedding — masked, never emitted)
+        self._tokens_dev = token_dev
+        self.timings['step'] += time.perf_counter() - started
+        emitted, finished = {}, []
+        for row in np.flatnonzero(self._active):
+            row = int(row)
+            self._tokens[row] = emitted[row] = int(token[row])
+            self._rowstate[row].tokens.append(int(token[row]))
+            reason = self._finish_reason(row)
+            if reason is not None:
+                state = self.evict(row)
+                finished.append((row, reason, list(state.tokens)))
+        return StepReport(emitted, finished)
+
+    # ------------------------------------------------------------- eviction
+
+    def evict(self, row: int) -> _RowState:
+        """Retire ``row`` (finished or cancelled): its blocks return to
+        the free list, its table resets to trash — a host-side edit plus
+        one fixed-shape table write, never a retrace."""
+        if row not in self._rowstate:
+            raise ValueError(f'row {row} is not seated')
+        self.pool.evict(row)
+        self._cache = write_tables(self._cache, self.pool.table)
+        self._active[row] = False
+        self._tokens[row] = 0
+        self._active_dev = self._active_dev.at[row].set(False)
+        self._free_rows.append(row)
+        return self._rowstate.pop(row)
+
+    def tokens(self, row: int) -> list:
+        """Tokens emitted so far for a seated row."""
+        return list(self._rowstate[row].tokens)

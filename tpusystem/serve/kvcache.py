@@ -1,0 +1,158 @@
+"""Paged KV-cache management: the free-list, block tables, and the
+device-side admission writes.
+
+The device layout lives in :func:`tpusystem.ops.attention.paged_attention`
+(one shared pool of ``num_blocks * block_size`` token slots per layer,
+per-row block tables mapping logical blocks to physical ones). This module
+is the **host-side authority** over that layout: which physical blocks are
+free, which row owns which blocks, and what every row's table says —
+:class:`PagedKVCache` — plus the two jitted cache edits the engine uses to
+change batch membership without retracing its decode step:
+
+* :func:`adopt_prefill` scatters a prefilled contiguous KV strip into a
+  row's allocated blocks (one program total — admission is a pair of
+  device calls, never a reshape of the pool);
+* :func:`write_tables` replaces every layer's ``table`` cache leaf with
+  the host authority's current map (evictions and admissions both reduce
+  to this table edit).
+
+Physical block 0 is the reserved **trash block**: unmapped table entries
+point there, so a retired row's dead writes (the fixed-shape step keeps
+computing every row) land in trash instead of a live row's blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TRASH_BLOCK = 0
+
+
+class PagedKVCache:
+    """Host-side free-list + block-table authority for the paged pool.
+
+    Pure bookkeeping (numpy only — unit-testable without a device):
+    ``admit`` allocates the blocks covering a sequence's whole token
+    budget up front (prompt + generation, so decode never stalls on a
+    mid-stream allocation), ``evict`` returns them to the free list and
+    resets the row's table to trash. The device copies of the tables are
+    refreshed from :attr:`table` via :func:`write_tables`.
+    """
+
+    def __init__(self, rows: int, blocks: int, block_size: int,
+                 max_seq: int) -> None:
+        if max_seq % block_size:
+            raise ValueError(f'max_seq ({max_seq}) must be a multiple of '
+                             f'block_size ({block_size})')
+        if blocks < 2:
+            raise ValueError('need at least 2 blocks (block 0 is the '
+                             'reserved trash block)')
+        self.rows, self.blocks, self.block_size = rows, blocks, block_size
+        self.max_blocks = max_seq // block_size
+        self.max_seq = max_seq
+        # LIFO free list over blocks 1..blocks-1 (0 is trash)
+        self._free = list(range(blocks - 1, 0, -1))
+        self._owned: dict[int, list[int]] = {}
+        self.table = np.full((rows, self.max_blocks), TRASH_BLOCK, np.int32)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, tokens: int) -> int:
+        """Physical blocks covering ``tokens`` cache slots."""
+        return -(-tokens // self.block_size)
+
+    def can_admit(self, tokens: int) -> bool:
+        needed = self.blocks_for(tokens)
+        return needed <= len(self._free) and needed <= self.max_blocks
+
+    def admit(self, row: int, tokens: int) -> np.ndarray:
+        """Allocate ``tokens`` worth of blocks to ``row`` and return the
+        ``[max_seq]`` physical token-slot map of the row (positions past
+        the allocation map to trash) — the scatter index
+        :func:`adopt_prefill` writes the prefilled KV through."""
+        if row in self._owned:
+            raise ValueError(f'row {row} already owns blocks — evict first')
+        needed = self.blocks_for(tokens)
+        if needed > self.max_blocks:
+            raise ValueError(f'{tokens} tokens need {needed} blocks, over '
+                             f'the per-row table width {self.max_blocks}')
+        if needed > len(self._free):
+            raise ValueError(f'{needed} blocks needed, {len(self._free)} '
+                             'free — admission must wait (queue, do not '
+                             'crash)')
+        ids = [self._free.pop() for _ in range(needed)]
+        self._owned[row] = ids
+        self.table[row, :needed] = ids
+        self.table[row, needed:] = TRASH_BLOCK
+        return self.slots(row)
+
+    def slots(self, row: int) -> np.ndarray:
+        """``[max_seq]`` physical token slot of each logical position of
+        ``row`` under its current table (trash wherever unmapped)."""
+        positions = np.arange(self.max_seq)
+        physical = self.table[row, positions // self.block_size]
+        return (physical * self.block_size
+                + positions % self.block_size).astype(np.int32)
+
+    def evict(self, row: int) -> int:
+        """Free ``row``'s blocks back to the pool; returns how many."""
+        freed = self._owned.pop(row, [])
+        self._free.extend(reversed(freed))
+        self.table[row] = TRASH_BLOCK
+        return len(freed)
+
+
+def _is_kv(path) -> bool:
+    return path[-1] in (jax.tree_util.DictKey('key'),
+                        jax.tree_util.DictKey('value'))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def adopt_prefill(cache, prefill_cache, slots, row, length):
+    """Admit a prefilled sequence into ``row`` of the paged cache.
+
+    ``prefill_cache`` is the contiguous decode cache a plain (non-paged)
+    prefill apply left behind — per-layer KV strips ``[1, max_seq, heads,
+    head_dim]``; ``slots`` is the row's ``[max_seq]`` physical token-slot
+    map (:meth:`PagedKVCache.slots`, trash-padded past the allocation, so
+    pad-bucket junk beyond the prompt scatters into trash or into
+    positions the decode write overwrites before the mask ever exposes
+    them); ``row``/``length`` set the row's cursors to the prompt length.
+    Tables are not touched here — :func:`write_tables` is the one table
+    authority. One compiled program for every admission (prefill strips
+    share one shape across buckets: the cache is allocated ``max_seq``
+    wide regardless of prompt length)."""
+    from tpusystem.train.cursors import is_cursor
+    source = {jax.tree_util.keystr(path): leaf for path, leaf
+              in jax.tree_util.tree_leaves_with_path(prefill_cache)}
+
+    def fix(path, leaf):
+        if _is_kv(path):
+            strip = source[jax.tree_util.keystr(path)][0]  # [max_seq, h, d]
+            return leaf.at[slots].set(strip.astype(leaf.dtype))
+        if is_cursor(path):
+            return leaf.at[row].set(jnp.asarray(length, leaf.dtype))
+        return leaf
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def write_tables(cache, tables):
+    """Replace every layer's ``table`` cache leaf with the host
+    authority's ``[rows, max_blocks]`` map (broadcast over a scanned
+    stack's leading layer dim). Admission maps a row's logical blocks to
+    its fresh allocation; eviction resets them to trash — either way the
+    whole membership change is this table edit plus (for admissions)
+    :func:`adopt_prefill`'s block writes."""
+    def fix(path, leaf):
+        if path[-1] == jax.tree_util.DictKey('table'):
+            return jnp.broadcast_to(jnp.asarray(tables, leaf.dtype),
+                                    leaf.shape)
+        return leaf
+    return jax.tree_util.tree_map_with_path(fix, cache)

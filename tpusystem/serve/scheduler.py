@@ -1,0 +1,212 @@
+"""Iteration-level scheduler: prefill/decode phase packing over the engine.
+
+Orca-style continuous batching as host policy over
+:class:`tpusystem.serve.Engine`: each :meth:`Scheduler.step` first
+**admits** queued requests into free rows — FIFO, within a prefill token
+budget so a burst of long prompts cannot starve the decode phase — then
+runs **one decode step** for every seated row, then maps the engine's
+retirements back to requests. A request the free-list cannot seat stays
+queued (never crashes — the ``Saturated`` contract), and drains in as
+rows and blocks free up.
+
+The engine keeps the PR-7 serving levers (``stream_dtype`` weight
+streaming); :func:`serve_levers` picks the fastest defaults for the
+current backend so serving rides the quantized streaming path on HBM-
+bound chips without per-deployment tuning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+
+import jax
+
+from tpusystem.serve.engine import Engine
+
+
+def serve_levers() -> dict:
+    """The default engine levers for serving on this backend: int8
+    weight streaming on TPU (decode there is weight-streaming bound —
+    half the bytes per step vs bf16, ``benchmarks/decode_roofline.py``),
+    'auto' elsewhere (CPU decode is compute-bound and f32 keeps the
+    engine token-exact against the f32 reference). The fused Pallas
+    decode chain and speculative drafts compose with ``generate()``
+    today; the paged step is its own implementation (docs/serving.md
+    records the composition matrix)."""
+    if jax.default_backend() in ('tpu', 'axon'):
+        return {'stream_dtype': 'int8'}
+    return {'stream_dtype': 'auto'}
+
+
+@dataclasses.dataclass
+class Request:
+    """One user request: a prompt and a generation budget.
+
+    Greedy decoding only (temperature sampling needs per-row rng
+    plumbing the engine does not carry yet); ``stop_token`` ends the
+    request early, with the stop token included in the output."""
+    id: str
+    prompt: object                   # int sequence
+    max_new: int
+    stop_token: int | None = None
+
+
+@dataclasses.dataclass
+class _Pending:
+    request: Request
+    submitted: float
+
+
+@dataclasses.dataclass
+class Completion:
+    request: Request
+    tokens: list
+    reason: str                      # 'length' | 'stop' | 'cancelled'
+    seconds: float                   # submit -> completion
+
+
+@dataclasses.dataclass
+class Tick:
+    """One scheduler step's outcome."""
+    admitted: list                   # [(Request, Admission, ttft_s), ...]
+    emitted: dict                    # request id -> token
+    completed: list                  # [Completion, ...]
+    queue_depth: int
+    active: int
+
+
+class Scheduler:
+    """FIFO continuous-batching scheduler over one engine.
+
+    Args:
+        engine: the :class:`~tpusystem.serve.Engine` to pack.
+        prefill_budget: max prompt tokens (bucket-padded) prefilled per
+            step. At least one admission always proceeds when capacity
+            exists, so a prompt wider than the whole budget cannot
+            starve.
+    """
+
+    def __init__(self, engine: Engine, *, prefill_budget: int = 512) -> None:
+        self.engine = engine
+        self.prefill_budget = prefill_budget
+        self._queue: deque[_Pending] = deque()
+        self._seated: dict[int, _Pending] = {}      # row -> pending
+        self.results: dict[str, Completion] = {}
+        self.steps = 0
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def active(self) -> int:
+        return len(self._seated)
+
+    @property
+    def idle(self) -> bool:
+        return not self._queue and not self._seated
+
+    def submit(self, request: Request) -> None:
+        """Queue a request. Requests that could NEVER fit (prompt +
+        max_new over the cache capacity) are refused immediately with a
+        ``ValueError`` instead of clogging the queue forever."""
+        prompt_len = len(request.prompt)
+        if prompt_len < 1 or request.max_new < 1:
+            raise ValueError('a request needs a non-empty prompt and '
+                             'max_new >= 1')
+        if prompt_len + request.max_new > self.engine.max_seq:
+            raise ValueError(
+                f'request {request.id!r}: prompt ({prompt_len}) + max_new '
+                f'({request.max_new}) exceeds the engine capacity '
+                f'max_seq={self.engine.max_seq}')
+        needed = self.engine.pool.blocks_for(prompt_len + request.max_new)
+        if needed > self.engine.pool.blocks - 1:
+            # even a fully drained pool could not back it — refusing now
+            # beats queueing it forever behind requests that CAN run
+            raise ValueError(
+                f'request {request.id!r} needs {needed} blocks but the '
+                f'pool has {self.engine.pool.blocks - 1} allocatable')
+        self._queue.append(_Pending(request, time.monotonic()))
+
+    def cancel(self, request_id: str) -> str | None:
+        """Cancel a request wherever it is: ``'queued'`` (silently
+        dropped), ``'active'`` (evicted mid-decode; partial tokens land
+        in :attr:`results` with reason ``'cancelled'``), or ``None``
+        when unknown/already completed."""
+        for pending in list(self._queue):
+            if pending.request.id == request_id:
+                self._queue.remove(pending)
+                return 'queued'
+        for row, pending in list(self._seated.items()):
+            if pending.request.id == request_id:
+                state = self.engine.evict(row)
+                del self._seated[row]
+                self.results[request_id] = Completion(
+                    pending.request, list(state.tokens), 'cancelled',
+                    time.monotonic() - pending.submitted)
+                return 'active'
+        return None
+
+    def step(self) -> Tick:
+        """One serving iteration: admit within the prefill budget, then
+        decode every seated row once."""
+        self.steps += 1
+        admitted, completed = [], []
+        budget = self.prefill_budget
+        while self._queue:
+            pending = self._queue[0]
+            request = pending.request
+            cost = self.engine.bucket(len(request.prompt))
+            if cost > budget and budget < self.prefill_budget:
+                break                    # budget spent this step
+            if not self.engine.can_admit(len(request.prompt),
+                                         request.max_new):
+                break                    # FIFO: wait for rows/blocks
+            self._queue.popleft()
+            admission = self.engine.admit(
+                request.prompt, request.max_new,
+                stop_token=request.stop_token, tag=request.id)
+            budget -= cost
+            ttft = time.monotonic() - pending.submitted
+            admitted.append((request, admission, ttft))
+            if admission.finished:
+                completed.append(self._complete(
+                    pending, [admission.token], admission.reason))
+            else:
+                self._seated[admission.row] = pending
+
+        report = self.engine.step()
+        emitted = {}
+        for row, token in report.emitted.items():
+            if row in self._seated:
+                emitted[self._seated[row].request.id] = token
+        for row, reason, tokens in report.finished:
+            # rows admitted directly on the engine (not through this
+            # scheduler) retire without a seat here — their caller got
+            # the tokens via the engine's StepReport
+            pending = self._seated.pop(row, None)
+            if pending is not None:
+                completed.append(self._complete(pending, list(tokens),
+                                                reason))
+        return Tick(admitted, emitted, completed, len(self._queue),
+                    len(self._seated))
+
+    def _complete(self, pending: _Pending, tokens: list,
+                  reason: str) -> Completion:
+        completion = Completion(pending.request, tokens, reason,
+                                time.monotonic() - pending.submitted)
+        self.results[pending.request.id] = completion
+        return completion
+
+    def run(self, max_steps: int = 10_000) -> dict:
+        """Step until every queued and seated request completes; returns
+        :attr:`results` (request id -> :class:`Completion`)."""
+        for _ in range(max_steps):
+            if self.idle:
+                return self.results
+            self.step()
+        raise RuntimeError(f'scheduler did not drain in {max_steps} steps '
+                           f'(queue {self.queue_depth}, active '
+                           f'{self.active})')

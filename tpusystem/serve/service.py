@@ -1,0 +1,120 @@
+"""The request bus front-end: serving as a message-driven service.
+
+Requests fan in as commands over the TorchSystem-style service layer
+(:class:`tpusystem.services.Service` — ``'submit'`` / ``'cancel'`` by
+name, so a CLI, REST surface, or the multihost control plane can drive
+the engine without importing it), and the request lifecycle fans out as
+domain events on a :class:`tpusystem.services.Producer`:
+``RequestAdmitted`` / ``RequestEvicted`` / ``RequestCompleted`` /
+``ServeStepped`` (:mod:`tpusystem.observe.events`). The TensorBoard
+consumer charts queue depth, time-to-first-token, and tokens/sec off
+those events with zero engine code — the observability discipline every
+other subsystem in this framework follows.
+
+Hot-path rule: every event payload is an already-materialized host value
+(ints, floats, token lists) — consumers never see device arrays.
+"""
+
+from __future__ import annotations
+
+import time
+
+from tpusystem.observe.events import (RequestAdmitted, RequestCompleted,
+                                      RequestEvicted, ServeStepped)
+from tpusystem.serve.engine import Engine
+from tpusystem.serve.scheduler import Request, Scheduler, serve_levers
+from tpusystem.services.prodcon import Producer
+from tpusystem.services.service import Service
+
+
+class InferenceService:
+    """Continuous-batching inference behind a command/event bus.
+
+    Composes an :class:`~tpusystem.serve.Engine` (built with
+    :func:`~tpusystem.serve.serve_levers` defaults — int8 weight
+    streaming on TPU) under a :class:`~tpusystem.serve.Scheduler`, and
+    narrates every lifecycle transition on ``producer``. Drive it
+    directly (:meth:`submit` / :meth:`step` / :meth:`run_until_idle`) or
+    by name through :attr:`service` (``handle('submit', request)``).
+    """
+
+    def __init__(self, module, params, *, producer: Producer | None = None,
+                 rows: int = 4, block_size: int = 16,
+                 blocks: int | None = None, prefill_budget: int = 512,
+                 **levers) -> None:
+        knobs = {**serve_levers(), **levers}
+        self.engine = Engine(module, params, rows=rows,
+                             block_size=block_size, blocks=blocks, **knobs)
+        self.scheduler = Scheduler(self.engine,
+                                   prefill_budget=prefill_budget)
+        self.producer = producer or Producer()
+        self._emitted = 0
+        self._started = None         # first-step wall clock, for tok/s
+        self.service = Service('serve')
+        self.service.handler(self._named('submit', self.submit))
+        self.service.handler(self._named('cancel', self.cancel))
+
+    @staticmethod
+    def _named(name, bound):
+        # Service registers by function __name__; bound methods carry the
+        # mangled method name, so wrap with the public command name
+        def command(*arguments):
+            return bound(*arguments)
+        command.__name__ = name
+        return command
+
+    # -------------------------------------------------------------- intake
+
+    def submit(self, request: Request) -> None:
+        """Queue a request (command name ``'submit'``)."""
+        self.scheduler.submit(request)
+
+    def cancel(self, request_id: str) -> str | None:
+        """Cancel a request (command name ``'cancel'``); an active one is
+        evicted mid-decode and narrated as ``RequestEvicted``."""
+        where = self.scheduler.cancel(request_id)
+        if where == 'active':
+            completion = self.scheduler.results[request_id]
+            self.producer.dispatch(RequestEvicted(
+                id=request_id, produced=len(completion.tokens),
+                reason='cancelled'))
+        return where
+
+    # ------------------------------------------------------------- serving
+
+    def step(self) -> None:
+        """One scheduler iteration, narrated on the bus."""
+        if self._started is None:
+            self._started = time.monotonic()
+        tick = self.scheduler.step()
+        for request, admission, ttft in tick.admitted:
+            self.producer.dispatch(RequestAdmitted(
+                id=request.id, row=admission.row,
+                prompt_tokens=len(request.prompt), ttft=ttft,
+                queue_depth=tick.queue_depth))
+        for completion in tick.completed:
+            if completion.reason != 'cancelled':
+                self.producer.dispatch(RequestCompleted(
+                    id=completion.request.id,
+                    produced=len(completion.tokens),
+                    reason=completion.reason,
+                    seconds=completion.seconds))
+        self._emitted += len(tick.admitted) + len(tick.emitted)
+        elapsed = time.monotonic() - self._started
+        self.producer.dispatch(ServeStepped(
+            step=self.scheduler.steps, active=tick.active,
+            queue_depth=tick.queue_depth, emitted=len(tick.emitted),
+            tokens_per_sec=self._emitted / elapsed if elapsed else 0.0))
+
+    def run_until_idle(self, max_steps: int = 10_000) -> dict:
+        """Step until every request completes; returns request id ->
+        :class:`~tpusystem.serve.Completion`."""
+        for _ in range(max_steps):
+            if self.scheduler.idle:
+                return self.scheduler.results
+            self.step()
+        raise RuntimeError(f'serving did not drain in {max_steps} steps')
+
+    @property
+    def results(self) -> dict:
+        return self.scheduler.results

@@ -1,0 +1,71 @@
+"""Per-row KV-cache cursor authority.
+
+Every path that manages decode-cache rows independently — speculative
+decoding's rewind-to-accepted-prefix (:mod:`tpusystem.train.generate`),
+token-tree verify's winner-row copy, and the serving engine's
+admit/evict row recycling (:mod:`tpusystem.serve.engine`) — edits the
+same two kinds of cache leaves: the per-layer ``index`` cursor that
+:func:`tpusystem.ops.attention.cached_attention` writes and masks at
+(and that Llama's rotary reads), and GPT-2's model-level ``position``
+offset. This module is the single implementation of those edits, so the
+speculative path and the engine cannot drift on which leaves count as
+cursors or how scanned stacks broadcast.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# The cache-collection leaf names that hold per-row cursor state: the
+# per-layer KV cursor (``index`` — also what Llama's rotary reads) and
+# GPT-2's learned-position offset (``position``).
+CURSOR_KEYS = (jax.tree_util.DictKey('index'),
+               jax.tree_util.DictKey('position'))
+
+
+def is_cursor(path) -> bool:
+    """True when a cache tree path addresses a cursor leaf."""
+    return path[-1] in CURSOR_KEYS
+
+
+def rewind(cache, cursor):
+    """Set every cache cursor to ``cursor`` (``[batch]`` int, or a
+    scalar broadcast over rows) — rows beyond it are garbage from
+    rejected speculation or a retired serving row, masked out by the
+    cursor-based attention mask and overwritten by the next accepted
+    tokens. Scanned stacks carry cursors at a leading layer dim; the
+    ``[batch]`` cursor broadcasts into whatever shape the leaf has."""
+    def fix(path, leaf):
+        if is_cursor(path):
+            return jnp.broadcast_to(jnp.asarray(cursor, leaf.dtype),
+                                    leaf.shape)
+        return leaf
+    return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def read_cursor(cache):
+    """The per-row ``[batch]`` cursor of a decode cache — the first
+    ``index`` leaf found (every layer's agrees under the :func:`rewind`
+    discipline; scanned stacks return layer 0's slice)."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        if path[-1] == jax.tree_util.DictKey('index'):
+            return leaf.reshape(-1, leaf.shape[-1])[0] if leaf.ndim > 1 \
+                else leaf
+    raise ValueError('no index cursor leaf in this cache tree — was the '
+                     'cache created by a decode-mode apply?')
+
+
+def gather_rows(cache, rows):
+    """Overwrite every row's cache with row ``rows[i]``'s (token-tree
+    verify's winner-copy): KV leaves gather on their batch axis — always
+    ``ndim - 4`` for the contiguous ``[..., batch, max_seq, heads,
+    head_dim]`` cache layout, which also covers scanned stacks' leading
+    layer dim — and cursor leaves (``index``/``position``) on their last
+    axis. Contiguous caches only: a paged cache's pool has no batch axis
+    (rows alias blocks through the table), so row copies there are block
+    copies, owned by :class:`tpusystem.serve.PagedKVCache`."""
+    def fix(path, leaf):
+        axis = leaf.ndim - 1 if is_cursor(path) else leaf.ndim - 4
+        return jnp.take(leaf, rows, axis=axis)
+    return jax.tree_util.tree_map_with_path(fix, cache)

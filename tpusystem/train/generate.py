@@ -18,6 +18,9 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from tpusystem.train.cursors import gather_rows as _gather_rows
+from tpusystem.train.cursors import rewind as _rewind
+
 
 def _decoder(module, per_row: bool = False):
     """Clone the module into decode mode: xla attention (flash/ring make no
@@ -31,9 +34,13 @@ def _decoder(module, per_row: bool = False):
     ordinary generation keeps the faster shared-cursor
     ``dynamic_update_slice`` (see ``cached_attention``)."""
     updates: dict = {'decode': True}
+    # decode_pages resets too: the paged layout needs externally managed
+    # block tables (tpusystem.serve.Engine sets it on ITS clone after
+    # this) — generate()'s own loops always run the contiguous cache
     for field, value in (('attention', 'xla'), ('dropout', 0.0),
                          ('return_features', False), ('remat', False),
-                         ('mesh', None), ('per_row_decode', per_row)):
+                         ('mesh', None), ('per_row_decode', per_row),
+                         ('decode_pages', None)):
         if hasattr(module, field):
             updates[field] = value
     return dataclasses.replace(module, **updates)
@@ -336,25 +343,6 @@ def speculative_generate(module, params, prompt, *, steps: int,
     return run(params, draft_params, prompt, rng)
 
 
-def _rewind(cache, cursor):
-    """Set every cache cursor back to ``cursor`` — rows beyond it are
-    garbage from rejected speculation, masked out by the cursor-based
-    attention mask and overwritten by the next accepted tokens. Covers the
-    per-layer KV cursors (``index`` — also what Llama's rotary reads) and
-    GPT-2's learned-position offset (``position``)."""
-    cursors = (jax.tree_util.DictKey('index'),
-               jax.tree_util.DictKey('position'))
-
-    def fix(path, leaf):
-        if path[-1] in cursors:
-            # scanned stacks carry cursors at a leading layer dim —
-            # broadcast the [batch] cursor to whatever shape the leaf has
-            return jnp.broadcast_to(jnp.asarray(cursor, leaf.dtype),
-                                    leaf.shape)
-        return leaf
-    return jax.tree_util.tree_map_with_path(fix, cache)
-
-
 @functools.cache
 def _compiled_speculative(decoder, drafter, steps: int, speculate: int,
                           temperature: float):
@@ -488,21 +476,6 @@ def _build_speculative(decoder, drafter, steps: int, speculate: int,
         return jnp.concatenate([prompt, out[:, :steps]], axis=1)
 
     return run
-
-
-def _gather_rows(cache, rows):
-    """Overwrite every branch row's cache with its group winner's
-    (token-tree verify): KV leaves gather on their batch axis — always
-    ``ndim - 4`` for the ``[..., batch, max_seq, heads, head_dim]``
-    cache layout, which also covers scanned stacks' leading layer dim —
-    and cursor leaves (``index``/``position``) on their last axis."""
-    cursors = (jax.tree_util.DictKey('index'),
-               jax.tree_util.DictKey('position'))
-
-    def fix(path, leaf):
-        axis = leaf.ndim - 1 if path[-1] in cursors else leaf.ndim - 4
-        return jnp.take(leaf, rows, axis=axis)
-    return jax.tree_util.tree_map_with_path(fix, cache)
 
 
 @functools.cache
