@@ -11,7 +11,10 @@ FSDP param-prefetch/grad-scatter hiding A/B,
 vs off — the < 2% budget tracked in BENCH_*.json from day one), then the
 ``recovery_seconds`` row (hot in-memory restore vs disk restore wall
 time on the tiny model — the per-recovery saving the Supervisor's
-memstore tier buys), then the ``decode_tok_s``/``decode_stream_bytes``
+memstore tier buys), then the ``resize_seconds`` row (elastic
+hot-reshard of a 4-host world onto a 2-host mesh vs the disk restore a
+cold restart would pay, ``benchmarks/elastic_resize.py headline``),
+then the ``decode_tok_s``/``decode_stream_bytes``
 rows (serving-path greedy decode throughput at the BASELINE decode
 config plus the per-step streamed weight bytes auto-vs-int8 — the
 roofline lever, ``benchmarks/decode_roofline.py``), then the
@@ -110,6 +113,16 @@ def fsdp_overlap_row() -> None:
     overlap scheduler's second client, `parallel/schedule.py`; BASELINE.md
     "fsdp_overlap protocol")."""
     _overlap_probe_row('fsdp_overlap.py', 'fsdp_overlap_speedup_vs_gspmd')
+
+
+def resize_seconds_row() -> None:
+    """The elastic-resize cost row: wall seconds to hot-reshard a 4-host
+    world's state onto a 2-host mesh from in-memory pieces vs restoring
+    the same step from disk onto the same mesh
+    (`benchmarks/elastic_resize.py`; the reshard the elastic loop
+    `tpusystem/parallel/elastic.py` performs instead of a cold
+    full-world restart)."""
+    _overlap_probe_row('elastic_resize.py', 'resize_seconds')
 
 
 def serve_row() -> None:
@@ -388,6 +401,7 @@ if __name__ == '__main__':
     fsdp_overlap_row()
     sentinel_overhead_row()
     recovery_seconds_row()
+    resize_seconds_row()
     decode_rows()
     serve_row()
     main()

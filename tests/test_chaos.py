@@ -864,6 +864,116 @@ class TestFlakySaves:
             assert checkpointer.latest(IDENTITY) == 4
 
 
+class TestBuddyDoubleLoss:
+    """Satellite: BOTH hosts of a replica pair die in one wave. Their
+    pieces exist only in each other's replica slots, so the hot tier is
+    unrecoverable for both — the elastic resize path must fall back to
+    disk and still land bitwise on the last committed step (the drill's
+    loss-equivalence), never deliver a partial hot state."""
+
+    def test_double_buddy_loss_falls_back_to_disk(self, tmp_path, caplog):
+        from tpusystem.checkpoint import Checkpointer, MemStoreClient
+        from tpusystem.models import gpt2_tiny
+        from tpusystem.parallel import (MeshSpec, Supervisor, TensorParallel,
+                                        batch_sharding)
+        from tpusystem.parallel.chaos import PreemptionWave
+        from tpusystem.parallel.elastic import (ElasticCoordinator,
+                                                ElasticPolicy, ResizeDecision,
+                                                collect_pieces, elastic_resume,
+                                                split_pieces)
+        from tpusystem.train import (AdamW, NextTokenLoss, build_train_step,
+                                     flax_apply, init_state)
+        identity = 'double-loss'
+        devices = jax.devices('cpu')
+        spec = MeshSpec(fsdp=4)          # every host holds UNIQUE shards
+        mesh4 = spec.build(devices[:4])
+        hub = Hub(4)
+        transports = [ChaosTransport(hub.address, rank, 4,
+                                     faults=Faults(seed=rank))
+                      for rank in range(4)]
+        assert wait_until(lambda: len(hub._clients) == 4)
+        supervisors = [Supervisor(['w'], rank=rank,
+                                  transport=transports[rank], buddy=rank ^ 1)
+                       for rank in range(4)]
+        policy = ElasticPolicy(settle_window=0.25, rebroadcast=0.1)
+        coords = [ElasticCoordinator(transports[rank], rank, 4,
+                                     policy=policy).start()
+                  for rank in (0, 1)]
+        clients = [MemStoreClient(supervisor.server.address)
+                   for supervisor in supervisors]
+        checkpointer = Checkpointer(tmp_path, async_save=False)
+        try:
+            module = gpt2_tiny(layers=2, dim=32, heads=2, max_seq=32)
+            optimizer = AdamW(lr=1e-3)
+            place = TensorParallel(module.partition_rules(), fsdp=True,
+                                   fsdp_min_size=16)
+            tokens = jnp.asarray(
+                np.random.default_rng(1).integers(0, 256, (4, 16)), jnp.int32)
+            state = place.place(init_state(module, optimizer, tokens[:1]),
+                                mesh4)
+            step = build_train_step(flax_apply(module), NextTokenLoss(),
+                                    optimizer)
+            placed = jax.device_put(tokens, batch_sharding(mesh4))
+            die_at = 2
+            # ranks 2 and 3 ARE a buddy pair: one wave takes both copies
+            wave = PreemptionWave(step=die_at, kills=(transports[2].kill,
+                                                      transports[3].kill))
+            while int(state.step) < die_at:
+                state, _ = step(state, placed, placed)
+                at = int(state.step)
+                checkpointer.save(identity, at, state, extras={'step': at})
+                for rank, blob in enumerate(split_pieces(state, mesh4, 4)):
+                    clients[rank].push(identity, at, blob,
+                                       extras={'step': at})
+                wave(at)
+            assert wave.fired
+
+            # the survivors agree the shrink — one epoch for the pair loss
+            assert wait_until(lambda: all(coord.decisions
+                                          for coord in coords))
+            for coord in coords:
+                assert coord.decisions == [
+                    ResizeDecision(epoch=1, members=(0, 1))]
+
+            # hot reshard CANNOT cover ranks 2/3's shards: typed fallback
+            mesh2 = spec.resized(2).build(devices[:2])
+            blank = place.place(init_state(module, optimizer, tokens[:1]),
+                                mesh2)
+            with caplog.at_level(logging.WARNING, 'tpusystem.elastic'):
+                pieces = collect_pieces(
+                    identity, rank=0, members=range(4), survivors=(0, 1),
+                    store=supervisors[0].store, transport=transports[0],
+                    buddy_of=lambda member: member ^ 1)
+                assert len(pieces) == 2          # only the survivors' own
+                restored, at, extras, source = elastic_resume(
+                    checkpointer, identity, blank, pieces)
+            assert 'no surviving buddy' in caplog.text
+            assert 'restore from disk' in caplog.text
+            assert source == 'disk' and at == die_at
+
+            # loss-equivalence: the fallen-back state IS the disk restore
+            # of the last committed step, and continues identically
+            disk = checkpointer.restore(identity, blank, epoch=die_at)
+            for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(disk)):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            placed2 = jax.device_put(tokens, batch_sharding(mesh2))
+            resumed, (_, loss_resumed) = step(restored, placed2, placed2)
+            reference, (_, loss_reference) = step(disk, placed2, placed2)
+            assert np.isfinite(float(loss_resumed))
+            assert float(loss_resumed) == float(loss_reference)
+        finally:
+            for client in clients:
+                client.close()
+            for coord in coords:
+                coord.close()
+            for supervisor in supervisors:
+                supervisor.close()
+            checkpointer.close()
+            for transport in transports:
+                transport.close()
+            hub.close()
+
+
 class TestBarrierTimeout:
     """Satellite: a peer dead/hung between sync points must surface as a
     typed CollectiveTimeout instead of hanging the barrier forever."""

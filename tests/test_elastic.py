@@ -1,0 +1,612 @@
+"""Elastic training: membership epochs, hot resharding, the wave drill.
+
+Three tiers, cheapest first:
+
+* **protocol** — the :class:`ElasticCoordinator` settle/propose/commit
+  machine driven by a fake clock over stub transports: a multi-host wave
+  folds into ONE resize, commits need every proposed member's echo,
+  ``min_world`` holds the line, flaps cancel, cooldown rate-limits,
+  replacement hosts bootstrap from the first proposal that includes
+  them, and a straggler whose pre-commit frames were dropped completes
+  via the re-echo;
+* **resharding** — :class:`ShardedLeaf` piece merging and
+  re-layout onto a different mesh, bitwise, with typed failures on
+  missing coverage and mixed steps;
+* **the drill** (the acceptance contract) — a real Hub + transports +
+  supervisors pod: a :class:`PreemptionWave` kills 2 of 4 hosts mid-run,
+  the survivors converge on ONE resize within the settle window,
+  training state hot-reshards onto the shrunk mesh **bitwise-equivalent
+  to restoring the same step from disk**, training takes another finite
+  step at the new size, and a returning host grows the world back —
+  never a cold full-world restart.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from tpusystem.parallel.elastic import (ElasticCoordinator, ElasticPolicy,
+                                        ResizeDecision, collect_pieces,
+                                        elastic_resume, split_pieces)
+from tpusystem.observe.events import (ElasticTimeline, WorldResizeProposed,
+                                      WorldResized)
+from tpusystem.services.prodcon import Consumer, Producer
+
+IDENTITY = 'elastic-drill'
+
+
+def wait_until(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# protocol: fake clock, stub transports, no sockets
+
+
+class FakeClock:
+    def __init__(self):
+        self.time = 0.0
+
+    def __call__(self):
+        return self.time
+
+    def advance(self, seconds):
+        self.time += seconds
+
+
+class StubTransport:
+    """The coordinator-facing transport surface, wires replaced by lists."""
+
+    def __init__(self, rank):
+        self.rank = rank
+        self._channels = {}
+        self.on_control = None
+        self.outbox = []
+
+    def subscribe(self, channel, callback):
+        self._channels[channel] = callback
+
+    def send_event(self, channel, message):
+        self.outbox.append((channel, message))
+
+    def deliver(self, channel, message):
+        self._channels[channel](message)
+
+
+def capture_elastic(producer=None):
+    producer = producer or Producer()
+    seen = []
+    consumer = Consumer()
+    for kind in (WorldResizeProposed, WorldResized, ElasticTimeline):
+        consumer.register(kind, seen.append)
+    producer.register(consumer)
+    return producer, seen
+
+
+class Pod:
+    """A stub supervisor pod: coordinators + hand-cranked frame routing."""
+
+    def __init__(self, size, clock, policy, capture_rank=0):
+        self.clock = clock
+        self.live = set(range(size))
+        self.stubs = [StubTransport(rank) for rank in range(size)]
+        self.producer, self.seen = capture_elastic()
+        self.coords = [
+            ElasticCoordinator(
+                self.stubs[rank], rank, size, policy=policy, clock=clock,
+                producer=self.producer if rank == capture_rank else None)
+            for rank in range(size)]
+
+    def lose(self, rank):
+        """The hub's 'lost' fanout: every other live host hears it (and
+        ingests it now — the live coordinator's poll thread would)."""
+        self.live.discard(rank)
+        for survivor in self.live:
+            self.stubs[survivor].on_control(('lost', rank, 0.0, 'socket'))
+        for survivor in sorted(self.live):
+            self.coords[survivor].step()
+
+    def join(self, rank):
+        """The hub's 'joined' fanout (excludes the joiner itself) — for a
+        host whose original coordinator is still running (a flapped
+        link, a fast rejoin)."""
+        for other in self.live:
+            if other != rank:
+                self.stubs[other].on_control(('joined', rank))
+        self.live.add(rank)
+        for member in sorted(self.live):
+            self.coords[member].step()
+
+    def replace(self, rank, policy):
+        """A replacement host: fresh transport + bootstrapping coordinator
+        (``members=None`` — it adopts the first proposal that includes
+        it)."""
+        while len(self.stubs) <= rank:
+            self.stubs.append(None)
+            self.coords.append(None)
+        self.stubs[rank] = StubTransport(rank)
+        self.coords[rank] = ElasticCoordinator(
+            self.stubs[rank], rank, policy=policy, clock=self.clock,
+            members=None)
+        self.join(rank)
+
+    def pump(self, rounds=6):
+        """Step every live coordinator and route every broadcast frame to
+        every OTHER live host — the hub's event fanout, hand-cranked."""
+        for _ in range(rounds):
+            for rank in sorted(self.live):
+                self.coords[rank].step()
+            for rank in sorted(self.live):
+                stub = self.stubs[rank]
+                while stub.outbox:
+                    channel, message = stub.outbox.pop(0)
+                    for other in sorted(self.live):
+                        if other != rank:
+                            self.stubs[other].deliver(channel, message)
+
+
+class TestProtocol:
+
+    def policy(self, **overrides):
+        knobs = dict(settle_window=1.0, rebroadcast=100.0)
+        knobs.update(overrides)
+        return ElasticPolicy(**knobs)
+
+    def test_wave_folds_multiple_losses_into_one_resize(self):
+        """The headline property: 2 losses inside one settle window are
+        ONE membership epoch, not two resizes."""
+        clock = FakeClock()
+        pod = Pod(5, clock, self.policy())
+        pod.lose(3)
+        pod.pump()
+        assert not any(coord.decisions for coord in pod.coords)
+        clock.advance(0.5)
+        pod.lose(4)                        # extends the settle window
+        pod.pump()
+        clock.advance(0.9)                 # 1.4 < 0.5 + 1.0... just under
+        pod.pump()
+        assert not any(pod.coords[rank].decisions for rank in pod.live)
+        clock.advance(0.2)                 # the window closes
+        pod.pump()
+        for rank in pod.live:
+            assert pod.coords[rank].decisions == [
+                ResizeDecision(epoch=1, members=(0, 1, 2))]
+        resized = [e for e in pod.seen if isinstance(e, WorldResized)]
+        assert len(resized) == 1           # ONE resize for the whole wave
+        assert resized[0].size == 3 and resized[0].epoch == 1
+        proposed = [e for e in pod.seen
+                    if isinstance(e, WorldResizeProposed)]
+        assert proposed and proposed[0].cause == 'loss'
+
+    def test_commit_requires_every_proposed_member(self):
+        clock = FakeClock()
+        pod = Pod(3, clock, self.policy())
+        pod.lose(2)
+        clock.advance(1.1)
+        pod.coords[0].step()               # proposes; only its own vote
+        assert pod.coords[0].step() is None
+        pod.coords[1].step()               # rank 1 proposes too
+        channel, message = pod.stubs[1].outbox.pop(0)
+        pod.stubs[0].deliver(channel, message)
+        decision = pod.coords[0].step()    # now every member voted
+        assert decision == ResizeDecision(epoch=1, members=(0, 1))
+
+    def test_min_world_holds_until_capacity_returns(self):
+        clock = FakeClock()
+        pod = Pod(4, clock, self.policy(min_world=3))
+        pod.lose(2)
+        pod.lose(3)
+        clock.advance(1.1)
+        pod.pump()
+        assert not pod.coords[0].decisions     # would shrink below min
+        pod.join(3)                            # capacity returns
+        clock.advance(1.1)
+        pod.pump()
+        for rank in pod.live:
+            assert pod.coords[rank].decisions[-1].members == (0, 1, 3)
+
+    def test_loss_flapping_back_within_the_window_cancels_the_wave(self):
+        clock = FakeClock()
+        pod = Pod(3, clock, self.policy())
+        pod.lose(2)
+        clock.advance(0.5)
+        pod.join(2)                        # the link flaked, host is back
+        clock.advance(1.1)
+        pod.pump()
+        assert not any(coord.decisions for coord in pod.coords)
+        assert pod.coords[0].members == (0, 1, 2)
+
+    def test_cooldown_defers_the_next_wave(self):
+        clock = FakeClock()
+        pod = Pod(4, clock, self.policy(cooldown=5.0))
+        pod.lose(3)
+        clock.advance(1.1)
+        pod.pump()
+        assert pod.coords[0].decisions[-1].epoch == 1
+        pod.lose(2)
+        clock.advance(1.1)                 # settle passed, cooldown not
+        pod.pump()
+        assert len(pod.coords[0].decisions) == 1
+        clock.advance(5.0)                 # cooldown expires
+        pod.pump()
+        assert pod.coords[0].decisions[-1] == ResizeDecision(
+            epoch=2, members=(0, 1))
+
+    def test_replacement_host_bootstraps_from_the_first_proposal(self):
+        clock = FakeClock()
+        policy = self.policy()
+        pod = Pod(3, clock, policy)
+        pod.lose(2)
+        clock.advance(1.1)
+        pod.pump()
+        assert pod.coords[0].members == (0, 1)
+        pod.replace(2, policy)             # fresh coordinator, members=None
+        clock.advance(1.1)
+        pod.pump()
+        for rank in (0, 1, 2):
+            assert pod.coords[rank].decisions[-1] == ResizeDecision(
+                epoch=2, members=(0, 1, 2))
+        assert pod.coords[2].members == (0, 1, 2)
+        assert pod.coords[2].epoch == 2
+
+    def test_max_world_caps_the_grow(self):
+        clock = FakeClock()
+        policy = self.policy(max_world=3)
+        pod = Pod(2, clock, policy)
+        pod.replace(2, policy)
+        pod.replace(3, policy)             # one joiner too many
+        clock.advance(1.1)
+        pod.pump()
+        assert pod.coords[0].decisions[-1].members == (0, 1, 2)
+        assert pod.coords[3].members is None     # left pending the cap
+
+    def test_flapped_out_host_adopts_the_readmission_epoch(self):
+        """Review regression: a host flapped OUT of a committed shrink
+        (it never saw the epoch) is later re-admitted — the peers'
+        higher-epoch proposal names the host's own stale member set, so
+        the old code computed no diff and silently dropped it, stalling
+        the commit forever. It must adopt-and-echo like a bootstrap."""
+        clock = FakeClock()
+        pod = Pod(3, clock, self.policy())
+        pod.lose(0)                        # rank 0 flaps out, sees nothing
+        clock.advance(1.1)
+        pod.pump()
+        for rank in (1, 2):
+            assert pod.coords[rank].decisions == [
+                ResizeDecision(epoch=1, members=(1, 2))]
+        assert pod.coords[0].epoch == 0    # it missed the whole epoch
+        pod.join(0)                        # the link comes back
+        clock.advance(1.1)
+        pod.pump()
+        for rank in (0, 1, 2):
+            assert pod.coords[rank].decisions[-1] == ResizeDecision(
+                epoch=2, members=(0, 1, 2)), rank
+        assert pod.coords[0].epoch == 2
+
+    def test_capped_joiner_stays_pending_for_the_next_wave(self):
+        """Review regression: a joiner held out by max_world used to be
+        silently cleared when the settle window closed; the policy's
+        contract is that it waits for a later wave with room."""
+        clock = FakeClock()
+        policy = self.policy(max_world=2)
+        pod = Pod(2, clock, policy)
+        pod.replace(2, policy)             # no room: world is at the cap
+        clock.advance(1.1)
+        pod.pump()
+        assert not pod.coords[0].decisions
+        pod.lose(1)                        # room opens
+        clock.advance(1.1)
+        pod.pump()
+        assert pod.coords[0].decisions[-1] == ResizeDecision(
+            epoch=1, members=(0, 2))       # the pending joiner folded in
+        assert pod.coords[2].members == (0, 2)
+
+    def test_close_unhooks_the_transport_and_ignores_late_frames(self):
+        """A coordinator outlived by its transport (a replacement host
+        builds a NEW coordinator on the same wire) must go inert on
+        close: no unbounded inbox growth, and the on_control chain head
+        restored."""
+        clock = FakeClock()
+        stub = StubTransport(0)
+        policy = self.policy()
+        first = ElasticCoordinator(stub, 0, 3, policy=policy, clock=clock)
+        second = ElasticCoordinator(stub, 0, 3, policy=policy, clock=clock)
+        second.close()
+        stub.on_control(('lost', 2, 0.0, 'socket'))   # reaches FIRST only
+        first.step()
+        assert first._lost == {2}
+        assert second._inbox.empty()       # closed: frames not hoarded
+        second._ingest(('lost', 1, 0.0, 'socket'))
+        assert second._inbox.empty()
+        first.close()
+        assert stub.on_control is None     # fully unhooked
+
+    def test_elastic_consumer_raises_at_the_drain(self):
+        """The worker-side 46 path: a committed WorldResized event raises
+        WorldResizedError from the bus drain, mapping to RESIZED_EXIT."""
+        from tpusystem.parallel.elastic import elastic_consumer
+        from tpusystem.parallel.recovery import (RESIZED_EXIT,
+                                                 WorldResizedError,
+                                                 exit_for_restart)
+        producer = Producer()
+        producer.register(elastic_consumer())
+        with pytest.raises(WorldResizedError) as excinfo:
+            producer.dispatch(WorldResized(epoch=2, members=[0, 2], size=2,
+                                           seconds=0.1))
+        assert excinfo.value.epoch == 2
+        assert excinfo.value.members == (0, 2)
+        assert exit_for_restart(excinfo.value).code == RESIZED_EXIT
+
+    def test_straggler_completes_via_the_reecho(self):
+        """Events are at-most-once: a rank whose pre-commit proposals were
+        all dropped must still commit — the committed side re-echoes when
+        it sees the straggler's rebroadcast."""
+        clock = FakeClock()
+        pod = Pod(3, clock, self.policy(rebroadcast=0.5))
+        pod.lose(2)
+        clock.advance(1.1)
+        pod.coords[0].step()
+        pod.stubs[0].outbox.clear()        # 0's proposal is dropped
+        pod.coords[1].step()               # 1 proposes
+        channel, message = pod.stubs[1].outbox.pop(0)
+        pod.stubs[0].deliver(channel, message)
+        assert pod.coords[0].step() is not None      # 0 commits
+        assert not pod.coords[1].decisions           # 1 is the straggler
+        clock.advance(0.6)                           # 1 rebroadcasts
+        pod.coords[1].step()
+        channel, message = pod.stubs[1].outbox.pop(0)
+        pod.stubs[0].deliver(channel, message)
+        pod.coords[0].step()                         # committed 0 re-echoes
+        channel, message = pod.stubs[0].outbox.pop(0)
+        pod.stubs[1].deliver(channel, message)
+        assert pod.coords[1].step() is not None
+        assert pod.coords[1].decisions == pod.coords[0].decisions
+
+
+class TestResizeDecision:
+
+    def test_rank_and_buddy_derivation(self):
+        decision = ResizeDecision(epoch=3, members=(0, 2, 5, 7))
+        assert decision.size == 4
+        assert [decision.rank_of(m) for m in decision.members] == [0, 1, 2, 3]
+        # buddies pair within the NEW dense ordering: (0,2) and (5,7)
+        assert decision.buddy_of(0) == 2 and decision.buddy_of(2) == 0
+        assert decision.buddy_of(5) == 7 and decision.buddy_of(7) == 5
+        odd = ResizeDecision(epoch=1, members=(1, 4, 6))
+        assert odd.buddy_of(6) is None     # the unpaired last member
+
+    def test_env_round_trip(self):
+        decision = ResizeDecision(epoch=2, members=(0, 3))
+        env = decision.env(3)
+        assert ResizeDecision.from_env(env) == (decision, 3)
+        assert ResizeDecision.from_env({}) is None
+        assert ResizeDecision.from_env(
+            {'TPUSYSTEM_ELASTIC': 'not json'}) is None
+
+
+# ---------------------------------------------------------------------------
+# resharding: piece merge + re-layout, bitwise
+
+
+class TestResharding:
+
+    def test_sharded_leaf_merges_and_reshards_across_meshes(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec
+        from tpusystem.checkpoint.memstore import ShardedLeaf
+        from tpusystem.parallel import MeshSpec
+        devices = jax.devices('cpu')
+        mesh4 = MeshSpec(data=4).build(devices[:4])
+        values = jnp.arange(64, dtype=jnp.float32).reshape(8, 8) * 1.37
+        sharded = jax.device_put(
+            values, NamedSharding(mesh4, PartitionSpec('data')))
+        whole = ShardedLeaf.from_array(sharded)
+        # split into two 'hosts' of 2 pieces each, then merge back
+        keys = sorted(whole.shards)
+        hosts = [ShardedLeaf(whole.shape, whole.dtype,
+                             {key: whole.shards[key] for key in keys[:2]}),
+                 ShardedLeaf(whole.shape, whole.dtype,
+                             {key: whole.shards[key] for key in keys[2:]})]
+        merged = hosts[0].merged(hosts[1])
+        assert len(merged.shards) == 4
+        # the 2-device mesh wants DIFFERENT slice boundaries: exact
+        # placement refuses, the reshard path reassembles bitwise
+        mesh2 = MeshSpec(data=2).build(devices[:2])
+        target = jax.device_put(
+            jnp.zeros_like(values), NamedSharding(mesh2,
+                                                  PartitionSpec('data')))
+        with pytest.raises(ValueError, match='do not cover'):
+            merged.place(target)
+        placed = merged.place(target, reshard=True)
+        np.testing.assert_array_equal(np.asarray(placed), np.asarray(values))
+        assert placed.sharding == target.sharding
+        # one host's pieces alone do not cover: typed failure -> disk
+        with pytest.raises(ValueError, match='cover only'):
+            hosts[0].place(target, reshard=True)
+
+    def test_merge_hot_refuses_mixed_steps(self):
+        from tpusystem.checkpoint.memstore import (HotState, blob_digest,
+                                                   merge_hot)
+        import pickle
+        blob = pickle.dumps([1])
+        entries = [HotState(step=3, digest=blob_digest(blob), blob=blob),
+                   HotState(step=4, digest=blob_digest(blob), blob=blob)]
+        with pytest.raises(ValueError, match='disagree on the step'):
+            merge_hot(entries)
+
+
+# ---------------------------------------------------------------------------
+# the drill: real Hub + transports + supervisors, a wave, one resize,
+# bitwise-equivalent reshard, grow back
+
+
+class TestWaveDrill:
+
+    def cell(self, mesh):
+        """One training cell on the given mesh: state, jitted step,
+        placed batch."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from tpusystem.models import gpt2_tiny
+        from tpusystem.parallel import (TensorParallel, batch_sharding)
+        from tpusystem.train import (AdamW, NextTokenLoss, build_train_step,
+                                     flax_apply, init_state)
+        module = gpt2_tiny(layers=2, dim=32, heads=2, max_seq=32)
+        optimizer = AdamW(lr=1e-3)
+        policy = TensorParallel(module.partition_rules(), fsdp=True,
+                                fsdp_min_size=16)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, (4, 16)), jnp.int32)
+        state = policy.place(init_state(module, optimizer, tokens[:1]), mesh)
+        step = build_train_step(flax_apply(module), NextTokenLoss(),
+                                optimizer)
+        placed = jax.device_put(tokens, batch_sharding(mesh))
+        return state, step, placed, policy, module, optimizer, tokens
+
+    def assert_bitwise(self, left, right):
+        import jax
+        import numpy as np
+        for a, b in zip(jax.tree.leaves(left), jax.tree.leaves(right)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_kill_two_of_four_resize_once_reshard_bitwise_grow_back(
+            self, tmp_path):
+        import jax
+        import numpy as np
+        from tpusystem.checkpoint import Checkpointer, MemStoreClient
+        from tpusystem.parallel import Hub, MeshSpec, TcpTransport, Supervisor
+        from tpusystem.parallel.chaos import ChaosTransport, PreemptionWave
+        from tpusystem.parallel import batch_sharding
+
+        devices = jax.devices('cpu')
+        spec = MeshSpec(fsdp=4)            # every host holds UNIQUE shards
+        mesh4 = spec.build(devices[:4])
+        hub = Hub(4)
+        # ChaosTransport everywhere: the real wire, and the doomed ranks'
+        # kill() is the crashed-host signature (EOF, no 'bye')
+        transports = [ChaosTransport(hub.address, rank, 4)
+                      for rank in range(4)]
+        assert wait_until(lambda: len(hub._clients) == 4)
+        supervisors = [Supervisor(['w'], rank=rank, transport=transports[rank],
+                                  buddy=rank ^ 1) for rank in range(4)]
+        producer, seen = capture_elastic()
+        policy = ElasticPolicy(settle_window=0.25, rebroadcast=0.1)
+        coords = [ElasticCoordinator(transports[rank], rank, 4, policy=policy,
+                                     producer=producer if rank == 0 else None,
+                                     on_resize=None).start()
+                  for rank in range(4)]
+        grow_extras = []
+        clients = []
+        checkpointer = Checkpointer(tmp_path, async_save=False)
+        try:
+            state, step, placed, place_policy, module, optimizer, tokens = \
+                self.cell(mesh4)
+            die_at = 2
+            wave = PreemptionWave(step=die_at,
+                                  kills=(transports[1].kill,
+                                         transports[3].kill))
+            clients = [MemStoreClient(supervisor.server.address)
+                       for supervisor in supervisors]
+            while int(state.step) < die_at:
+                state, (_, loss) = step(state, placed, placed)
+                at = int(state.step)
+                checkpointer.save(IDENTITY, at, state, extras={'step': at})
+                # each "host" pushes only ITS pieces (the multi-host
+                # serialize_state contract, simulated on virtual devices)
+                for rank, blob in enumerate(split_pieces(state, mesh4, 4)):
+                    clients[rank].push(IDENTITY, at, blob,
+                                       extras={'step': at})
+                if at == die_at:
+                    # buddy replication is async behind the push ack; the
+                    # drill pins the HOT reshard path, so the wave must
+                    # not beat the step-die_at replicas to the survivors
+                    # (a wave that DOES beat replication is the disk-
+                    # fallback case, drilled in test_chaos.py)
+                    assert wait_until(lambda: all(
+                        (held := supervisors[rank].store.newest(
+                            IDENTITY, replica=True)) is not None
+                        and held.step == die_at for rank in (0, 2)))
+                wave(at)
+            assert wave.fired
+
+            # --- ONE resize for the whole 2-host wave ------------------
+            assert wait_until(lambda: bool(coords[0].decisions
+                                           and coords[2].decisions))
+            time.sleep(3 * policy.settle_window)     # no second epoch
+            for rank in (0, 2):
+                assert coords[rank].decisions == [
+                    ResizeDecision(epoch=1, members=(0, 2))]
+            resized = [e for e in seen if isinstance(e, WorldResized)]
+            assert len(resized) == 1 and resized[0].size == 2
+            decision = coords[0].decisions[0]
+            assert decision.buddy_of(0) == 2         # pairs re-derived
+
+            # --- hot reshard onto the shrunk mesh, bitwise vs disk -----
+            mesh2 = spec.resized(2).build(devices[:2])
+            from tpusystem.train import init_state
+            blank = place_policy.place(
+                init_state(module, optimizer, tokens[:1]), mesh2)
+            restored = {}
+            for rank in decision.members:
+                pieces = collect_pieces(
+                    IDENTITY, rank=rank, members=range(4),
+                    survivors=decision.members,
+                    store=supervisors[rank].store,
+                    transport=transports[rank],
+                    buddy_of=lambda member: member ^ 1)
+                assert len(pieces) == 4              # all four hosts' shards
+                restored[rank] = elastic_resume(checkpointer, IDENTITY,
+                                                blank, pieces)
+            for rank, (got, at, extras, source) in restored.items():
+                assert source == 'hot-reshard', (rank, source)
+                assert at == die_at and extras == {'step': die_at}
+            disk = checkpointer.restore(IDENTITY, blank, epoch=die_at)
+            self.assert_bitwise(restored[0][0], disk)
+            self.assert_bitwise(restored[2][0], disk)
+
+            # --- training continues at n-k with a finite loss ----------
+            state2 = restored[0][0]
+            placed2 = jax.device_put(tokens, batch_sharding(mesh2))
+            state2, (_, loss2) = step(state2, placed2, placed2)
+            assert int(state2.step) == die_at + 1
+            assert np.isfinite(float(loss2))
+            coords[0].resumed(step=int(state2.step), source='hot-reshard')
+            timelines = [e for e in seen if isinstance(e, ElasticTimeline)]
+            assert len(timelines) == 1
+            assert timelines[0].source == 'hot-reshard'
+            assert timelines[0].size == 2
+
+            # --- a returning host grows the world back -----------------
+            hub.readmit(3)
+            replacement = TcpTransport(hub.address, 3, 4)
+            transports.append(replacement)
+            grow_extras.append(ElasticCoordinator(
+                replacement, 3, policy=policy, members=None).start())
+            assert wait_until(lambda: all(
+                coord.decisions and coord.decisions[-1].epoch == 2
+                for coord in (coords[0], coords[2], grow_extras[0])))
+            for coord in (coords[0], coords[2], grow_extras[0]):
+                assert coord.decisions[-1].members == (0, 2, 3)
+            assert [e.size for e in seen
+                    if isinstance(e, WorldResized)] == [2, 3]
+        finally:
+            for client in clients:
+                client.close()
+            for coord in coords + grow_extras:
+                coord.close()
+            for supervisor in supervisors:
+                supervisor.close()
+            checkpointer.close()
+            for transport in transports:
+                transport.close()
+            hub.close()

@@ -9,6 +9,8 @@ prompt-length bucketing bounds the prefill program count, and the
 request lifecycle narrates on the service bus.
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -395,6 +397,94 @@ def test_stop_token_completes_early(served):
     results = scheduler.run()
     assert results['s'].reason == 'stop'
     assert results['s'].tokens == expected[:first_hit + 1]  # stop included
+
+
+# ---------------------------------------------------------------------------
+# deadlines: saturation starvation becomes a typed expiry, never silence
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expires_queued_requests_instead_of_starving(served):
+    """The fixed gap: under saturation a queued request could wait
+    forever. With a deadline it expires — typed reason, empty tokens —
+    and the seated neighbor is untouched (still token-exact)."""
+    module, params = served
+    rng = np.random.default_rng(21)
+    engine = Engine(module, params, rows=1, block_size=8)
+    scheduler = Scheduler(engine)
+    prompt = list(rng.integers(0, 256, (4,)))
+    scheduler.submit(Request('hog', prompt, max_new=12))
+    scheduler.submit(Request('starved', prompt, max_new=4, deadline=0.05))
+    tick = scheduler.step()                # hog seats; starved waits
+    assert tick.queue_depth == 1 and not tick.expired
+    time.sleep(0.08)
+    tick = scheduler.step()
+    assert [(completion.request.id, where)
+            for completion, where in tick.expired] == [('starved', 'queued')]
+    starved = scheduler.results['starved']
+    assert starved.reason == 'expired' and starved.tokens == []
+    assert starved.seconds >= 0.05
+    scheduler.run()
+    hog = scheduler.results['hog']
+    assert hog.reason == 'length'
+    assert hog.tokens == reference(module, params, prompt, 12)
+
+
+def test_deadline_evicts_active_requests_mid_decode(served):
+    """An ACTIVE request past its deadline is evicted mid-decode: partial
+    tokens kept, row and blocks freed, neighbors token-exact."""
+    module, params = served
+    rng = np.random.default_rng(23)
+    engine = Engine(module, params, rows=2, block_size=8)
+    scheduler = Scheduler(engine)
+    slow = list(rng.integers(0, 256, (5,)))
+    quick = list(rng.integers(0, 256, (6,)))
+    scheduler.submit(Request('slow', slow, max_new=50, deadline=0.05))
+    scheduler.submit(Request('quick', quick, max_new=6))
+    scheduler.step()                       # both seated, decoding
+    time.sleep(0.08)
+    tick = scheduler.step()
+    assert [(completion.request.id, where)
+            for completion, where in tick.expired] == [('slow', 'active')]
+    expired = scheduler.results['slow']
+    assert expired.reason == 'expired'
+    assert 0 < len(expired.tokens) < 50    # partial output survives
+    scheduler.run()
+    assert scheduler.results['quick'].tokens == reference(
+        module, params, quick, 6)
+
+
+def test_deadline_validation(served):
+    module, params = served
+    engine = Engine(module, params, rows=1, block_size=8)
+    scheduler = Scheduler(engine)
+    with pytest.raises(ValueError, match='deadline'):
+        scheduler.submit(Request('bad', [1, 2, 3], max_new=4, deadline=0.0))
+
+
+def test_service_narrates_request_expired(served):
+    from tpusystem.observe.events import RequestExpired
+    from tpusystem.services.prodcon import Consumer, Producer
+
+    module, params = served
+    rng = np.random.default_rng(27)
+    witnessed = []
+    consumer = Consumer('probe')
+    consumer.register(RequestExpired, witnessed.append)
+    producer = Producer()
+    producer.register(consumer)
+    service = InferenceService(module, params, producer=producer, rows=1,
+                               block_size=8)
+    prompt = list(rng.integers(0, 256, (4,)))
+    service.submit(Request('hog', prompt, max_new=8))
+    service.submit(Request('starved', prompt, max_new=4, deadline=0.05))
+    service.step()
+    time.sleep(0.08)
+    service.run_until_idle()
+    assert len(witnessed) == 1
+    event = witnessed[0]
+    assert event.id == 'starved' and event.where == 'queued'
+    assert event.produced == 0 and event.waited >= 0.05
 
 
 # ---------------------------------------------------------------------------

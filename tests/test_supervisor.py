@@ -40,8 +40,9 @@ from tpusystem.observe.events import (RecoveryTimeline, WorkerExited,
 from tpusystem.parallel.multihost import Hub, TcpTransport
 from tpusystem.parallel.recovery import (CRASH_LOOP_EXIT, DIVERGED_EXIT,
                                          FAILURE_EXIT, LOST_WORKER_EXIT,
-                                         PREEMPTED_EXIT, DivergenceError,
-                                         Preempted, WorkerLostError,
+                                         PREEMPTED_EXIT, RESIZED_EXIT,
+                                         DivergenceError, Preempted,
+                                         WorkerLostError, WorldResizedError,
                                          exit_for_restart)
 from tpusystem.parallel.supervisor import Supervisor
 from tpusystem.services.prodcon import Consumer, Producer
@@ -58,6 +59,7 @@ class TestExitContract:
     @pytest.mark.parametrize('reason, code', [
         (WorkerLostError(1, 2.0), LOST_WORKER_EXIT),
         (Preempted(signal_module.SIGTERM), PREEMPTED_EXIT),
+        (WorldResizedError(1, (0, 2)), RESIZED_EXIT),
         (DivergenceError('gave up', step=7), DIVERGED_EXIT),
         (ValueError('a plain bug'), FAILURE_EXIT),
         (KeyboardInterrupt(), FAILURE_EXIT),
@@ -174,16 +176,113 @@ class TestSupervisorPolicy:
         assert [event.action for event in seen
                 if isinstance(event, WorkerExited)] == ['halt']
 
-    @pytest.mark.parametrize('code', [LOST_WORKER_EXIT, PREEMPTED_EXIT, -9])
+    @pytest.mark.parametrize('code', [LOST_WORKER_EXIT, PREEMPTED_EXIT,
+                                      RESIZED_EXIT, -9])
     def test_restartable_codes_relaunch(self, code):
-        """42, 43 and signal deaths (a SIGKILLed worker IS the worker-lost
-        case) relaunch; the run ends when the worker completes."""
+        """42, 43, 46 and signal deaths (a SIGKILLed worker IS the
+        worker-lost case) relaunch; the run ends when the worker
+        completes."""
         clock = FakeClock()
         popen = scripted(FakeWorker(code), FakeWorker(0))
         supervisor = policy_supervisor(popen, clock, crash_loop_k=5)
         assert supervisor.run() == 0
         assert len(popen.launched) == 2
         assert supervisor.restarts == 1
+
+    @pytest.mark.parametrize('signum, outcome', [
+        (signal_module.SIGKILL, 'relaunch'),   # OOM-killer / SIGKILLed pod
+        (signal_module.SIGTERM, 'relaunch'),   # eviction the worker missed
+        (signal_module.SIGSEGV, 'relaunch'),   # process failed as a unit
+        (signal_module.SIGBUS, 'relaunch'),
+        (signal_module.SIGINT, 'halt'),        # ^C is operator intent
+        (signal_module.SIGQUIT, 'halt'),       # ^\ likewise
+    ])
+    def test_signal_death_verdict_table(self, signum, outcome):
+        """The fixed gap: every ``code < 0`` used to relaunch — a worker
+        dying to the operator's own ^C/^\\ would be respawned forever,
+        fighting the human. SIGINT/SIGQUIT now halt for triage like exit
+        1; genuine process deaths still relaunch."""
+        clock = FakeClock()
+        popen = scripted(FakeWorker(-signum), FakeWorker(0))
+        supervisor = policy_supervisor(popen, clock, crash_loop_k=5)
+        seen = capture_events(supervisor)
+        code = supervisor.run()
+        actions = [e.action for e in seen if isinstance(e, WorkerExited)]
+        if outcome == 'relaunch':
+            assert code == 0 and len(popen.launched) == 2
+            assert actions == ['relaunch', 'done']
+        else:
+            assert code == FAILURE_EXIT and len(popen.launched) == 1
+            assert actions == ['halt']
+            assert seen[0].code == -signum     # the event keeps the truth
+
+    def test_resize_relaunches_under_the_new_spec_without_backoff(self):
+        """The elastic commit hook: resize() drains the worker (SIGTERM),
+        merges the new world spec into its env, re-points the buddy, and
+        relaunches immediately — no backoff, no crash-loop sample."""
+        clock = FakeClock()
+        box = {}
+
+        def trigger(worker):
+            if worker.count == 1:
+                box['sup'].resize({'TPUSYSTEM_ELASTIC': 'new-spec'}, buddy=2)
+
+        first = FakeWorker(PREEMPTED_EXIT, polls=3, on_poll=trigger)
+        popen = scripted(first, FakeWorker(0))
+        supervisor = policy_supervisor(popen, clock)
+        box['sup'] = supervisor
+        seen = capture_events(supervisor)
+        assert supervisor.run() == 0
+        assert len(popen.launched) == 2
+        assert signal_module.SIGTERM in first.signals       # the drain
+        assert popen.launched[0].get('TPUSYSTEM_ELASTIC') is None
+        assert popen.launched[1]['TPUSYSTEM_ELASTIC'] == 'new-spec'
+        assert supervisor.buddy == 2                        # re-paired
+        assert [s for s in clock.slept if s >= 1.0] == []   # no backoff
+        actions = [e.action for e in seen if isinstance(e, WorkerExited)]
+        assert actions == ['resize', 'done']
+
+    def test_resize_during_backoff_applies_before_the_relaunch(self):
+        """A resize committed while the supervisor sleeps out a backoff
+        must fold into the environment BEFORE the relaunch — spawning a
+        worker under the stale world spec just to SIGTERM it would waste
+        a whole worker start."""
+        clock = FakeClock()
+        box = {}
+
+        def sleep_then_resize(seconds):
+            clock.sleep(seconds)
+            if seconds >= 1.0:            # the backoff sleep, not a poll
+                box['sup'].resize({'TPUSYSTEM_ELASTIC': 'spec'}, buddy=2)
+
+        relaunched = FakeWorker(0)
+        popen = scripted(FakeWorker(LOST_WORKER_EXIT), relaunched)
+        supervisor = Supervisor(['worker'], memstore=False, popen=popen,
+                                clock=clock, sleep=sleep_then_resize,
+                                backoff_base=1.0, backoff_jitter=0.0)
+        box['sup'] = supervisor
+        assert supervisor.run() == 0
+        assert len(popen.launched) == 2
+        assert popen.launched[1]['TPUSYSTEM_ELASTIC'] == 'spec'
+        assert supervisor.buddy == 2
+        assert relaunched.signals == []   # fresh worker never SIGTERMed
+
+    def test_operator_sigint_outranks_a_pending_resize(self):
+        """^C while a resize drain is in flight still halts: the pending
+        resize must not dress an operator interrupt as a relaunch."""
+        clock = FakeClock()
+        box = {}
+
+        def trigger(worker):
+            if worker.count == 1:
+                box['sup'].resize({'TPUSYSTEM_ELASTIC': 'spec'})
+
+        popen = scripted(FakeWorker(-signal_module.SIGINT, polls=3,
+                                    on_poll=trigger))
+        supervisor = policy_supervisor(popen, clock)
+        box['sup'] = supervisor
+        assert supervisor.run() == FAILURE_EXIT
+        assert len(popen.launched) == 1
 
     def test_backoff_grows_exponentially_and_caps(self):
         """Relaunch delays follow min(cap, base * 2**attempt): measured on

@@ -13,11 +13,11 @@ each shard directly onto its mesh position.
 from tpusystem.checkpoint.checkpointer import Checkpointer, abstract_like
 from tpusystem.checkpoint.memstore import (HotState, MemStore, MemStoreClient,
                                            MemStoreServer, deserialize_state,
-                                           hot_resume, serialize_state,
-                                           supervisor_client)
+                                           hot_resume, merge_hot,
+                                           serialize_state, supervisor_client)
 from tpusystem.checkpoint.repository import Repository
 
 __all__ = ['Checkpointer', 'Repository', 'abstract_like',
            'MemStore', 'MemStoreServer', 'MemStoreClient', 'HotState',
-           'serialize_state', 'deserialize_state', 'hot_resume',
+           'serialize_state', 'deserialize_state', 'hot_resume', 'merge_hot',
            'supervisor_client']

@@ -52,7 +52,7 @@ logger = logging.getLogger('tpusystem.memstore')
 
 __all__ = ['MemStore', 'MemStoreServer', 'MemStoreClient', 'HotState',
            'serialize_state', 'deserialize_state', 'hot_resume',
-           'supervisor_client', 'SUPERVISOR_ENV']
+           'merge_hot', 'supervisor_client', 'SUPERVISOR_ENV']
 
 # how a supervised worker finds its supervisor's memstore endpoint
 SUPERVISOR_ENV = 'TPUSYSTEM_SUPERVISOR'
@@ -103,10 +103,53 @@ class ShardedLeaf:
                 shards[key] = np.asarray(shard.data)
         return cls(tuple(leaf.shape), np.dtype(leaf.dtype).str, shards)
 
-    def place(self, leaf: Any) -> Any:
+    def merged(self, other: 'ShardedLeaf') -> 'ShardedLeaf':
+        """Union this host's pieces with another host's pieces of the SAME
+        global array (the elastic-reshard assembly step: each survivor
+        contributes its own shards, lost hosts' shards arrive via their
+        buddies' replica blobs). Shape/dtype must agree; overlapping
+        slices keep either copy (replicas hold identical bytes)."""
+        import numpy as np
+        if tuple(self.shape) != tuple(other.shape) or \
+                np.dtype(self.dtype) != np.dtype(other.dtype):
+            raise ValueError(
+                f'cannot merge shards of different arrays: '
+                f'{self.shape}/{self.dtype} vs {other.shape}/{other.dtype}')
+        shards = dict(self.shards)
+        shards.update(other.shards)
+        return ShardedLeaf(self.shape, self.dtype, shards)
+
+    def reassemble(self) -> Any:
+        """The full global array from the held pieces, host-side — the
+        re-layout path of an elastic resize, where the new mesh's slice
+        boundaries need not line up with the old pieces. Raises
+        ``ValueError`` when the pieces do not tile the whole array (a
+        contributor's blob is missing; callers fall back to disk)."""
+        import numpy as np
+        full = np.empty(self.shape, np.dtype(self.dtype))
+        covered = np.zeros(self.shape, bool)
+        for key, data in self.shards.items():
+            slices = tuple(slice(start, stop, step)
+                           for start, stop, step in key)
+            full[slices] = data
+            covered[slices] = True
+        if not covered.all():
+            raise ValueError(
+                f'hot shards cover only {int(covered.sum())} of '
+                f'{covered.size} elements of a {self.shape} leaf — a '
+                f'contributor\'s pieces are missing; restore from disk')
+        return full
+
+    def place(self, leaf: Any, reshard: bool = False) -> Any:
         """Reassemble onto ``leaf``'s sharding (raises ``ValueError`` when
         the target layout wants a slice this host never held — e.g. a
-        resize between push and restore; callers fall back to disk)."""
+        resize between push and restore; callers fall back to disk).
+
+        ``reshard=True`` is the elastic path: when the exact per-device
+        slices do not line up (the mesh changed size), reassemble the
+        full array from the pieces and re-lay it out onto the target
+        sharding — still a ``ValueError`` when the pieces do not cover
+        the array."""
         import jax
         import numpy as np
         if tuple(self.shape) != tuple(leaf.shape) or \
@@ -124,6 +167,15 @@ class ShardedLeaf:
         for device, index in index_map.items():
             data = self.shards.get(_index_key(index, self.shape))
             if data is None:
+                if reshard:
+                    # new slice boundaries: rebuild the global array and
+                    # let each (local) device take its slice of it —
+                    # make_array_from_callback stays valid when the target
+                    # sharding spans hosts (only local slices are read)
+                    full = self.reassemble()
+                    return jax.make_array_from_callback(
+                        tuple(self.shape), sharding,
+                        lambda index: full[index])
                 raise ValueError(
                     'hot shards do not cover the restore layout (the mesh '
                     'changed since the push); restore from disk')
@@ -154,7 +206,8 @@ def serialize_state(state: Any) -> bytes:
     return pickle.dumps(leaves, protocol=pickle.HIGHEST_PROTOCOL)
 
 
-def deserialize_state(blob: bytes, target: Any) -> Any:
+def deserialize_state(blob: bytes, target: Any, *,
+                      reshard: bool = False) -> Any:
     """Rebuild a pytree from :func:`serialize_state` bytes onto ``target``.
 
     ``target`` is a concrete or abstract pytree (see
@@ -163,6 +216,11 @@ def deserialize_state(blob: bytes, target: Any) -> Any:
     exactly like a disk restore — current mesh, current layout. A
     structure, shape, or layout mismatch raises ``ValueError`` (the
     caller falls back to disk); it is never silently coerced.
+
+    ``reshard=True`` is the elastic-resize path: sharded pieces whose old
+    slice boundaries no longer line up with the target mesh are
+    reassembled and re-laid-out (:meth:`ShardedLeaf.place`) instead of
+    refused — shape/dtype/structure mismatches still raise.
     """
     import jax
     leaves, treedef = jax.tree.flatten(target)
@@ -175,7 +233,7 @@ def deserialize_state(blob: bytes, target: Any) -> Any:
     placed = []
     for value, leaf in zip(values, leaves):
         if isinstance(value, ShardedLeaf):
-            placed.append(value.place(leaf))
+            placed.append(value.place(leaf, reshard=reshard))
             continue
         shape = getattr(leaf, 'shape', None)
         dtype = getattr(leaf, 'dtype', None)
@@ -216,6 +274,47 @@ def unpack_hot(data: bytes, source: str = 'replica') -> HotState:
     step, digest, extras, blob = pickle.loads(data)
     return HotState(step=int(step), digest=digest, blob=blob, extras=extras,
                     source=source)
+
+
+def merge_hot(entries: list[HotState]) -> HotState:
+    """Fold several hosts' hot blobs of the SAME step into one blob whose
+    :class:`ShardedLeaf` leaves carry the union of every host's pieces —
+    the assembly step of an elastic resize: each survivor contributes its
+    own blob, lost hosts' blobs come from their buddies' replica slots.
+
+    All entries must carry the same step (a mixed-step merge would stitch
+    two different states together — refused with ``ValueError``; the
+    caller falls back to disk). Fully-addressable leaves travel whole in
+    every blob, so the first entry's copy is kept. ``extras`` come from
+    the first entry (loader cursors are global, pushed identically by
+    every host at the shared step cadence).
+    """
+    if not entries:
+        raise ValueError('nothing to merge: no hot-state contributions')
+    steps = {entry.step for entry in entries}
+    if len(steps) > 1:
+        raise ValueError(
+            f'hot-state contributions disagree on the step ({sorted(steps)});'
+            f' a mixed-step merge would stitch two states — restore from '
+            f'disk')
+    merged_leaves: list | None = None
+    for entry in entries:
+        leaves = pickle.loads(entry.blob)
+        if merged_leaves is None:
+            merged_leaves = list(leaves)
+            continue
+        if len(leaves) != len(merged_leaves):
+            raise ValueError(
+                f'hot-state contributions disagree on the leaf count '
+                f'({len(merged_leaves)} vs {len(leaves)}); restore from disk')
+        for index, leaf in enumerate(leaves):
+            held = merged_leaves[index]
+            if isinstance(held, ShardedLeaf) and isinstance(leaf, ShardedLeaf):
+                merged_leaves[index] = held.merged(leaf)
+    blob = pickle.dumps(merged_leaves, protocol=pickle.HIGHEST_PROTOCOL)
+    first = entries[0]
+    return HotState(step=first.step, digest=blob_digest(blob), blob=blob,
+                    extras=first.extras, source='merged')
 
 
 class MemStore:

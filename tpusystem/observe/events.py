@@ -136,6 +136,18 @@ class RequestCompleted:
 
 
 @event
+class RequestExpired:
+    """A request's ``deadline`` passed before it finished; ``where`` says
+    whether it was still ``'queued'`` (never seated — the starvation
+    case under saturation) or ``'active'`` (evicted mid-decode);
+    ``produced`` tokens were emitted by then."""
+    id: str
+    where: str
+    produced: int
+    waited: float
+
+
+@event
 class ServeStepped:
     """One scheduler iteration: current batch occupancy and queue depth,
     plus the sliding tokens-per-second the engine is sustaining."""
@@ -185,6 +197,50 @@ class RecoveryTimeline:
     whole MTTR, ``source`` where the state came back from
     (``hot``/``disk``)."""
     rank: int
+    step: int | None
+    source: str | None
+    seconds: float
+    stages: dict
+
+
+# --------------------------------------------------------------------------
+# elastic events — the membership-epoch protocol
+# (tpusystem.parallel.elastic): every proposed and committed world resize
+# is a domain event, so the ledger orders a preemption-wave incident and
+# TensorBoard charts the world size and resize latency over time.
+
+
+@event
+class WorldResizeProposed:
+    """A supervisor's settle window closed and it broadcast a membership
+    proposal; ``cause`` is what opened the wave (``'loss'`` / ``'join'``
+    / ``'both'``)."""
+    rank: int
+    epoch: int
+    members: list
+    cause: str
+
+
+@event
+class WorldResized:
+    """The membership epoch committed: every proposed member echoed the
+    same (epoch, members) proposal; workers restart under the new world
+    spec. ``seconds`` is wave-open → commit."""
+    epoch: int
+    members: list
+    size: int
+    seconds: float
+
+
+@event
+class ElasticTimeline:
+    """One full elastic resize, wave-open → training resumed at the new
+    size: ``stages`` maps each breadcrumb (``propose``, ``commit``,
+    ``restore``, plus anything the resuming side marked) to seconds
+    since the wave opened; ``source`` is where the state came back from
+    (``hot-reshard``/``disk``)."""
+    epoch: int
+    size: int
     step: int | None
     source: str | None
     seconds: float

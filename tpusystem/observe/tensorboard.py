@@ -27,10 +27,11 @@ import struct
 import time
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
-                                      RecoveryTimeline, ReplicaDiverged,
-                                      RequestAdmitted, RolledBack,
+                                      ElasticTimeline, RecoveryTimeline,
+                                      ReplicaDiverged, RequestAdmitted,
+                                      RequestExpired, RolledBack,
                                       ServeStepped, Trained, Validated,
-                                      WorkerExited)
+                                      WorkerExited, WorldResized)
 from tpusystem.services.prodcon import Consumer, Depends
 
 # ---------------------------------------------------------------- crc32c ---
@@ -233,6 +234,20 @@ def tensorboard_consumer() -> Consumer:
                          event.step)
         board.add_scalar('serve/tok_s', event.tokens_per_sec, event.step)
 
+    # deadline expiries: charted against an expiry counter (requests have
+    # no global step), split by where the request died — a queue full of
+    # expiries reads as saturation, active expiries as slow decode
+    expire_counts = [0]
+
+    @consumer.handler
+    def on_request_expired(event: RequestExpired,
+                           board: SummaryWriter = Depends(writer)) -> None:
+        expire_counts[0] += 1
+        board.add_scalar('serve/expired_total', float(expire_counts[0]),
+                         expire_counts[0])
+        board.add_scalar(f'serve/expired_waited_{event.where}',
+                         event.waited, expire_counts[0])
+
     @consumer.handler
     def on_recovery(event: RecoveryTimeline,
                     board: SummaryWriter = Depends(writer)) -> None:
@@ -242,5 +257,24 @@ def tensorboard_consumer() -> Consumer:
             board.add_scalar(f'supervisor/rank{event.rank}/restore_hot',
                              1.0 if event.source == 'hot' else 0.0,
                              event.step or 0)
+
+    # elastic resizes: world size over membership epochs plus the two
+    # latencies that matter — wave-open → commit (the settle/agreement
+    # cost) and wave-open → resumed (the whole reshard) — so a
+    # preemption-wave incident reads straight off the dashboard
+    @consumer.handler
+    def on_world_resized(event: WorldResized,
+                         board: SummaryWriter = Depends(writer)) -> None:
+        board.add_scalar('elastic/world_size', float(event.size), event.epoch)
+        board.add_scalar('elastic/commit_seconds', event.seconds, event.epoch)
+
+    @consumer.handler
+    def on_elastic_timeline(event: ElasticTimeline,
+                            board: SummaryWriter = Depends(writer)) -> None:
+        board.add_scalar('elastic/resize_seconds', event.seconds, event.epoch)
+        if event.source is not None:   # 1.0 = hot reshard (RAM), 0.0 = disk
+            board.add_scalar('elastic/reshard_hot',
+                             1.0 if event.source == 'hot-reshard' else 0.0,
+                             event.epoch)
 
     return consumer

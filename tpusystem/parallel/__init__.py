@@ -27,12 +27,18 @@ from tpusystem.parallel.pipeline import (PipelineParallel,
                                          pipeline_apply, pipeline_train)
 from tpusystem.parallel.chaos import (ChaosHub, ChaosTransport, CorruptBatch,
                                       CorruptGrads, DieAtStep, Faults,
-                                      FlipParamBit, WorkerKilled)
+                                      FlipParamBit, PreemptionWave,
+                                      WorkerKilled)
+from tpusystem.parallel.elastic import (ELASTIC_ENV, ElasticCoordinator,
+                                        ElasticPolicy, ResizeDecision,
+                                        collect_pieces, elastic_consumer,
+                                        elastic_resume)
 from tpusystem.parallel.recovery import (CRASH_LOOP_EXIT, DIVERGED_EXIT,
                                          FAILURE_EXIT, LOST_WORKER_EXIT,
-                                         PREEMPTED_EXIT, RESTART_EXITS,
-                                         DivergenceError, Preempted,
-                                         WorkerLostError, exit_for_restart,
+                                         PREEMPTED_EXIT, RESIZED_EXIT,
+                                         RESTART_EXITS, DivergenceError,
+                                         Preempted, WorkerLostError,
+                                         WorldResizedError, exit_for_restart,
                                          recovery_consumer)
 from tpusystem.parallel.supervisor import Supervisor
 from tpusystem.parallel.sharding import (
@@ -53,9 +59,13 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'WorkerLostError', 'recovery_consumer', 'LOST_WORKER_EXIT',
            'Preempted', 'PREEMPTED_EXIT', 'RESTART_EXITS', 'exit_for_restart',
            'DivergenceError', 'DIVERGED_EXIT', 'CRASH_LOOP_EXIT',
+           'RESIZED_EXIT', 'WorldResizedError',
            'FAILURE_EXIT', 'Supervisor', 'BlobError', 'BLOB_CHUNK',
+           'ELASTIC_ENV', 'ElasticCoordinator', 'ElasticPolicy',
+           'ResizeDecision', 'collect_pieces', 'elastic_consumer',
+           'elastic_resume',
            'Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
-           'CorruptGrads', 'CorruptBatch', 'FlipParamBit',
+           'PreemptionWave', 'CorruptGrads', 'CorruptBatch', 'FlipParamBit',
            'all_reduce_sum', 'all_reduce_mean', 'all_gather',
            'reduce_scatter', 'all_to_all', 'ring_shift',
            'ring_shift_chunked', 'axis_index', 'axis_size',

@@ -42,7 +42,7 @@ from typing import Any, Callable
 from tpusystem.parallel.multihost import Hub, TcpTransport
 
 __all__ = ['Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
-           'CorruptGrads', 'CorruptBatch', 'FlipParamBit']
+           'PreemptionWave', 'CorruptGrads', 'CorruptBatch', 'FlipParamBit']
 
 
 @dataclass
@@ -249,6 +249,42 @@ class DieAtStep:
             os._exit(self.code)
         else:
             raise WorkerKilled(self.step)
+
+
+@dataclass
+class PreemptionWave:
+    """Scripted multi-host loss at a chosen global step — the elastic
+    drill's signature fault: k of n hosts die *together* (a spot-market
+    reclaim, a rack power event), and the membership protocol must fold
+    every loss into ONE resize (the settle window's job), never one
+    resize per host.
+
+    ``kills`` are callables fired in order (``transport.kill`` for a
+    control-plane-only death, ``os.kill`` of a worker for the real
+    thing); ``stagger`` seconds between them models losses spread inside
+    a wave — pick it below the coordinator's settle window to assert the
+    one-resize contract, above it to drill the two-epoch case. Same
+    fired-once discipline as :class:`DieAtStep`::
+
+        wave = PreemptionWave(step=5, kills=(t2.kill, t3.kill))
+        for batch in loader:
+            state, _ = step(state, *batch)
+            wave(int(state.step))
+    """
+
+    step: int
+    kills: tuple = ()
+    stagger: float = 0.0
+    fired: bool = field(default=False, init=False)
+
+    def __call__(self, current_step: int) -> None:
+        if self.fired or current_step != self.step:
+            return
+        self.fired = True
+        for index, kill in enumerate(self.kills):
+            if index and self.stagger:
+                time.sleep(self.stagger)
+            kill()
 
 
 # ---------------------------------------------------------------------------

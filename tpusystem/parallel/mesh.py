@@ -240,6 +240,33 @@ class MeshSpec:
         shape = tuple(sizes[axis] for axis in AXES)
         return Mesh(np.asarray(devices).reshape(shape), AXES)
 
+    def resized(self, device_count: int) -> 'MeshSpec':
+        """The same layout policy scaled to a new device count — the mesh
+        derivation of an elastic resize (:mod:`tpusystem.parallel.elastic`).
+
+        A wildcard spec (one axis ``-1``) already scales: the wildcard
+        re-fills over the new count. A fully pinned spec scales its
+        ``data`` axis — or ``fsdp`` when the data axis cannot absorb the
+        change — keeping ``model``/``seq``/``expert``/``stage`` fixed:
+        those axis sizes encode kernel and memory-layout choices a resize
+        must not silently change. Raises ``ValueError`` when no data-like
+        axis divides the new count (resize to a compatible world or
+        restart with a new spec deliberately).
+        """
+        sizes = dict(self.sizes)
+        if any(size == -1 for size in sizes.values()):
+            spec = MeshSpec(**sizes)
+            spec.resolved_sizes(device_count)     # validate divisibility now
+            return spec
+        for axis in (DATA, FSDP):
+            fixed = math.prod(size for name, size in sizes.items()
+                              if name != axis)
+            if device_count % fixed == 0:
+                return MeshSpec(**{**sizes, axis: device_count // fixed})
+        raise ValueError(
+            f'cannot rescale mesh {sizes} to {device_count} devices: '
+            f'neither the data nor the fsdp axis divides the new count')
+
 
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     """A 1x1x1x1x1x1 mesh over one chip — the degenerate case that keeps

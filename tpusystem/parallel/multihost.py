@@ -494,6 +494,23 @@ class Hub:
         would let it believe it participated). Caller holds the lock."""
         return set(range(self.size)) - self._excluded
 
+    def readmit(self, rank: int) -> None:
+        """Return a previously excluded rank to the collective quota — the
+        elastic grow's re-admission step (:mod:`tpusystem.parallel.
+        elastic`).
+
+        The quota normally only shrinks (see :meth:`_live`): a restarted
+        worker's op counters restart at 0 and can never line up with the
+        survivors' mid-stream. Re-admission is therefore only sound at a
+        *membership-epoch boundary*, when EVERY rank restarts its
+        counters together — exactly what the resize relaunch guarantees
+        (all workers re-exec under the new world spec). Call it on the
+        hub when the epoch commits folding ``rank`` back in; calling it
+        into a live, counting pod would desync collective keys."""
+        with self._locks:
+            self._excluded.discard(rank)
+            self._lost.discard(rank)
+
     def _emit_result(self, op_key: tuple, values: dict[int, Any]) -> None:
         # include every contribution received for this op — a rank that
         # voted and then died still voted

@@ -52,15 +52,21 @@ logger = logging.getLogger('tpusystem.recovery')
 # adjust hyperparameters between attempts). 45 is emitted by the
 # *launcher* side (:class:`tpusystem.parallel.Supervisor`) when the worker
 # crash-loops: restartable exits kept arriving within seconds of launch,
-# so relaunching has stopped making progress — halt for triage. 1 is the
-# generic non-restart failure (an unrecognized exception is a bug, not a
-# recoverable fault — relaunching it forever would hide it).
+# so relaunching has stopped making progress — halt for triage. 46 is the
+# elastic-resize handshake (:mod:`tpusystem.parallel.elastic`): the
+# supervisors agreed a NEW world size and this worker must be relaunched
+# under the new world spec — restartable by definition (the relaunch IS
+# the resize), and distinct from 42/43 so the timeline and ledger can
+# tell a planned reshard from a fault. 1 is the generic non-restart
+# failure (an unrecognized exception is a bug, not a recoverable fault —
+# relaunching it forever would hide it).
 LOST_WORKER_EXIT = 42
 PREEMPTED_EXIT = 43
 DIVERGED_EXIT = 44
 CRASH_LOOP_EXIT = 45
+RESIZED_EXIT = 46
 FAILURE_EXIT = 1
-RESTART_EXITS = frozenset({LOST_WORKER_EXIT, PREEMPTED_EXIT})
+RESTART_EXITS = frozenset({LOST_WORKER_EXIT, PREEMPTED_EXIT, RESIZED_EXIT})
 
 
 class WorkerLostError(RuntimeError):
@@ -113,6 +119,30 @@ class Preempted(RuntimeError):
         self.signum = signum
 
 
+class WorldResizedError(RuntimeError):
+    """The supervisors agreed a new world size; this worker must restart
+    under the new spec.
+
+    Raised on the host loop at a drain point by
+    :func:`tpusystem.parallel.elastic.elastic_consumer` when the elastic
+    protocol (:class:`tpusystem.parallel.elastic.ElasticCoordinator`)
+    commits a membership epoch while the worker is mid-run. Maps to
+    :data:`RESIZED_EXIT` (46), which IS in :data:`RESTART_EXITS`: the
+    relaunch is the resize — the supervisor re-execs the worker with the
+    new world spec in its environment, the worker rebuilds the mesh at
+    the agreed size and hot-reshards its state from the memstore tier
+    (:func:`tpusystem.parallel.elastic.elastic_resume`).
+    """
+
+    def __init__(self, epoch: int, members: tuple):
+        super().__init__(
+            f'world resized to {len(members)} hosts (membership epoch '
+            f'{epoch}, members {sorted(members)}); exit {RESIZED_EXIT} so '
+            f'the supervisor relaunches under the new world spec')
+        self.epoch = epoch
+        self.members = tuple(members)
+
+
 class DivergenceError(RuntimeError):
     """Training diverged beyond the sentinel's escalation ladder.
 
@@ -137,11 +167,12 @@ def exit_for_restart(reason: BaseException) -> SystemExit:
 
     ``raise exit_for_restart(error)`` ends the process with the exit code
     the launcher contract recognizes: :data:`RESTART_EXITS` (42 worker
-    lost / 43 preempted) relaunch the job and resume from the last
-    committed checkpoint; :data:`DIVERGED_EXIT` (44, from
-    :class:`DivergenceError`) halts for triage.
+    lost / 43 preempted / 46 resized) relaunch the job and resume from
+    the last committed checkpoint (for 46: under the new world spec);
+    :data:`DIVERGED_EXIT` (44, from :class:`DivergenceError`) halts for
+    triage.
 
-    Only the three recovery exceptions map to contract codes. Anything
+    Only the recovery exceptions map to contract codes. Anything
     else — a plain ``ValueError``, ``KeyboardInterrupt``, an assertion —
     is a *bug*, not a recoverable fault, and returns the generic
     :data:`FAILURE_EXIT`: mapping unknown exceptions to a restartable
@@ -151,6 +182,8 @@ def exit_for_restart(reason: BaseException) -> SystemExit:
         return SystemExit(LOST_WORKER_EXIT)
     if isinstance(reason, Preempted):
         return SystemExit(PREEMPTED_EXIT)
+    if isinstance(reason, WorldResizedError):
+        return SystemExit(RESIZED_EXIT)
     if isinstance(reason, DivergenceError):
         return SystemExit(DIVERGED_EXIT)
     return SystemExit(FAILURE_EXIT)

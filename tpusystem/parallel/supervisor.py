@@ -13,7 +13,9 @@ Gemini's redundant in-memory model-state copies):
   RESTART_EXITS` (and signal deaths — a SIGKILLed worker *is* the
   worker-lost case) relaunch with capped exponential backoff + jitter;
   :data:`~tpusystem.parallel.recovery.DIVERGED_EXIT` and every unknown
-  code halt for triage (relaunching a deterministic failure replays it).
+  code halt for triage (relaunching a deterministic failure replays it),
+  and so do SIGINT/SIGQUIT deaths — those are *operator intent*, not a
+  fault, and relaunching would fight the human holding ^C.
 * **crash-loop containment** — ``crash_loop_k`` consecutive restartable
   exits, each within ``crash_loop_window`` seconds of the worker's
   first-step mark (or of launch, when it never got that far), end the
@@ -66,7 +68,8 @@ from typing import TYPE_CHECKING, Any, Callable
 
 from tpusystem.parallel.multihost import BlobError
 from tpusystem.parallel.recovery import (CRASH_LOOP_EXIT, DIVERGED_EXIT,
-                                         PREEMPTED_EXIT, RESTART_EXITS)
+                                         FAILURE_EXIT, PREEMPTED_EXIT,
+                                         RESIZED_EXIT, RESTART_EXITS)
 
 if TYPE_CHECKING:  # deferred at runtime: memstore pulls in the (orbax-
     # backed) checkpoint package, which must not tax `import
@@ -78,7 +81,15 @@ logger = logging.getLogger('tpusystem.supervisor')
 __all__ = ['Supervisor']
 
 _CODE_NAMES = {0: 'completed', 42: 'worker-lost', 43: 'preempted',
-               44: 'diverged'}
+               44: 'diverged', RESIZED_EXIT: 'resized'}
+
+# signal deaths relaunch (a SIGKILLed worker IS the worker-lost case) —
+# EXCEPT these: SIGINT (^C) and SIGQUIT (^\) are *operator intent*, a
+# human asking this worker to stop. Relaunching would fight the operator
+# forever; halt for triage like exit 1.
+_HALT_SIGNALS = frozenset({signal_module.SIGINT, signal_module.SIGQUIT})
+
+_UNSET = object()
 
 
 def _describe(code: int) -> str:
@@ -163,6 +174,10 @@ class Supervisor:
         self._poll_interval = poll_interval
         self._rng = random.Random(seed)
         self._terminate = threading.Event()
+        self._resize = threading.Event()
+        self._resize_lock = threading.Lock()
+        self._resize_env: dict[str, str] = {}
+        self._resize_buddy: Any = _UNSET
         self._repl_lock = threading.Lock()
         self._repl_pending: dict[str, Any] = {}
         self._repl_wake = threading.Event()
@@ -194,7 +209,9 @@ class Supervisor:
     # for 'hot:{identity}' — distinct keys, so a replaced host's pull can
     # never be satisfied by the buddy's own concurrent push of ITS state
     # (fetch_blob additionally pins the sender, but the key split keeps
-    # the two flows unmistakable on the wire)
+    # the two flows unmistakable on the wire). 'own:{identity}' asks for
+    # the peer's OWN local slot — the elastic reshard's survivor fetch
+    # (tpusystem.parallel.elastic.collect_pieces), again key-distinct.
 
     def _replicate(self, identity: str, entry: Any) -> None:
         """Queue a verified push for cross-host replication.
@@ -247,11 +264,27 @@ class Supervisor:
             logger.warning('replica of %r from rank %d rejected (%s)',
                            identity, sender, error)
 
+    @staticmethod
+    def _strip_member(rest: str) -> str:
+        # elastic fetch keys may carry a member-rank segment
+        # ('own:{member}:{identity}') purely to keep concurrent fetches
+        # of DIFFERENT peers' pieces key-distinct on the fetching
+        # transport (fetch_blob allows one in-flight fetch per key); the
+        # serving side answers from its own slots either way
+        prefix, sep, remainder = rest.partition(':')
+        return remainder if sep and prefix.isdigit() else rest
+
     def _serve_replica(self, key: str) -> bytes | None:
-        if not key.startswith('hot:') or self.store is None:
+        if self.store is None:
             return None
         from tpusystem.checkpoint.memstore import pack_hot
-        entry = self.store.newest(key[4:], replica=True)
+        if key.startswith('hot:'):
+            entry = self.store.newest(self._strip_member(key[4:]),
+                                      replica=True)
+        elif key.startswith('own:'):
+            entry = self.store.newest(self._strip_member(key[4:]))
+        else:
+            return None
         return None if entry is None else pack_hot(entry)
 
     def _pull_from_buddy(self, identity: str) -> Any:
@@ -322,6 +355,42 @@ class Supervisor:
         Safe from a signal handler or another thread."""
         self._terminate.set()
 
+    def resize(self, env: dict[str, str] | None = None, *,
+               buddy: int | None | object = _UNSET) -> None:
+        """Restart the worker under a NEW world spec (the elastic commit
+        hook — :class:`tpusystem.parallel.elastic.ElasticCoordinator`'s
+        ``on_resize`` side).
+
+        Unlike :meth:`terminate` this is not an eviction: the worker is
+        SIGTERMed (same grace → SIGKILL ladder) so it drains and exits,
+        and the supervisor relaunches it *immediately* — no backoff, no
+        crash-loop accounting — with ``env`` merged into its environment
+        (typically :meth:`~tpusystem.parallel.elastic.ResizeDecision.env`)
+        and, when given, ``buddy`` re-pointed at the new pairing so hot
+        replication resumes against the new rank set. Safe from another
+        thread (the coordinator's poll thread calls it).
+        """
+        with self._resize_lock:
+            if env:
+                self._resize_env = {**self._resize_env, **env}
+            if buddy is not _UNSET:
+                self._resize_buddy = buddy
+            self._resize.set()
+
+    def _apply_resize(self) -> None:
+        """Fold the pending resize spec into the relaunch environment and
+        buddy pairing, clearing the request. The lock keeps a SECOND
+        resize() (the coordinator's next epoch, on its own thread) from
+        losing its spec between this method's read and reset."""
+        with self._resize_lock:
+            self._resize.clear()
+            if self._resize_env:
+                self.env.update(self._resize_env)
+                self._resize_env = {}
+            if self._resize_buddy is not _UNSET:
+                self.buddy = self._resize_buddy
+                self._resize_buddy = _UNSET
+
     def install_signal_handler(self, *signals: int) -> None:
         """Arm :meth:`terminate` on the given signals (default SIGTERM).
         Main thread only — same Python constraint as
@@ -352,6 +421,13 @@ class Supervisor:
                             'relaunch; exiting %d', self.rank,
                             PREEMPTED_EXIT)
                 return PREEMPTED_EXIT
+            if self._resize.is_set():
+                # the resize committed while no worker was running (a
+                # backoff sleep, or between exit and relaunch): fold the
+                # new spec in BEFORE launching — spawning under the stale
+                # world just to SIGTERM it would waste a whole worker
+                # start and dial the control plane at the old size
+                self._apply_resize()
             env = {**os.environ, **self.env}
             if self.server is not None:
                 env.update(self.server.env)
@@ -392,7 +468,28 @@ class Supervisor:
                                             action='done', uptime=uptime,
                                             reason=reason))
                 return 0
-            restartable = code in RESTART_EXITS or code < 0
+            if self._resize.is_set() and (
+                    code in RESTART_EXITS
+                    or (code < 0 and -code not in _HALT_SIGNALS)):
+                # a requested elastic resize: the exit (43 from our own
+                # SIGTERM, 46 from the worker's drain, or a signal death
+                # after the grace) is the handshake, not a fault — apply
+                # the new world spec and relaunch NOW, outside the
+                # backoff ladder and the crash-loop accounting. An
+                # operator's SIGINT/SIGQUIT still halts below: a pending
+                # resize does not outrank the human holding ^C.
+                self._apply_resize()
+                self._timeline = {'detect': self._clock()}
+                self.restarts += 1
+                self._dispatch(WorkerExited(rank=self.rank, code=code,
+                                            action='resize', uptime=uptime,
+                                            reason=reason))
+                logger.info('rank %d: worker exited %s for a world resize; '
+                            'relaunching under the new spec', self.rank,
+                            reason)
+                continue
+            restartable = code in RESTART_EXITS or (
+                code < 0 and -code not in _HALT_SIGNALS)
             if not restartable:
                 action = 'halt'
                 self._dispatch(WorkerExited(rank=self.rank, code=code,
@@ -402,8 +499,13 @@ class Supervisor:
                     'rank %d: worker exited %d (%s) — not a restart code; '
                     'halting for triage%s', self.rank, code, reason,
                     ' (divergence: a blind relaunch would replay it)'
-                    if code == DIVERGED_EXIT else '')
-                return code
+                    if code == DIVERGED_EXIT else
+                    ' (operator signal: relaunching would fight the human)'
+                    if code < 0 else '')
+                # a signal death has no pass-through-able positive code
+                # (SystemExit(-2) surfaces as a meaningless shell status):
+                # operator-intent signals halt like the generic failure
+                return code if code >= 0 else FAILURE_EXIT
 
             # crash-loop containment: a restartable exit within the window
             # of first-step (or of launch, if it never got that far) made
@@ -452,10 +554,13 @@ class Supervisor:
             if code is not None:
                 return code
             self._drain_marks()
-            if self._terminate.is_set() and term_sent_at is None:
+            if (self._terminate.is_set() or self._resize.is_set()) \
+                    and term_sent_at is None:
                 term_sent_at = self._clock()
                 logger.info('rank %d: forwarding SIGTERM to worker '
-                            '(grace %.0fs)', self.rank, self.grace)
+                            '(%s, grace %.0fs)', self.rank,
+                            'resize' if self._resize.is_set() else 'drain',
+                            self.grace)
                 try:
                     worker.send_signal(signal_module.SIGTERM)
                 except (OSError, ValueError):

@@ -46,11 +46,17 @@ class Request:
 
     Greedy decoding only (temperature sampling needs per-row rng
     plumbing the engine does not carry yet); ``stop_token`` ends the
-    request early, with the stop token included in the output."""
+    request early, with the stop token included in the output.
+    ``deadline`` (seconds from submission, None = forever) bounds the
+    request's whole life: a queued request that cannot be seated before
+    it — the starvation case under saturation — or an active one still
+    decoding past it expires with a typed ``RequestExpired`` event and
+    reason ``'expired'`` instead of waiting silently forever."""
     id: str
     prompt: object                   # int sequence
     max_new: int
     stop_token: int | None = None
+    deadline: float | None = None
 
 
 @dataclasses.dataclass
@@ -63,7 +69,7 @@ class _Pending:
 class Completion:
     request: Request
     tokens: list
-    reason: str                      # 'length' | 'stop' | 'cancelled'
+    reason: str                      # 'length' | 'stop' | 'cancelled' | 'expired'
     seconds: float                   # submit -> completion
 
 
@@ -75,6 +81,8 @@ class Tick:
     completed: list                  # [Completion, ...]
     queue_depth: int
     active: int
+    expired: list = dataclasses.field(default_factory=list)
+    # [(Completion, 'queued' | 'active'), ...] — deadline expiries this step
 
 
 class Scheduler:
@@ -116,6 +124,10 @@ class Scheduler:
         if prompt_len < 1 or request.max_new < 1:
             raise ValueError('a request needs a non-empty prompt and '
                              'max_new >= 1')
+        if request.deadline is not None and request.deadline <= 0:
+            raise ValueError(
+                f'request {request.id!r}: deadline must be positive seconds '
+                f'from submission, got {request.deadline!r}')
         if prompt_len + request.max_new > self.engine.max_seq:
             raise ValueError(
                 f'request {request.id!r}: prompt ({prompt_len}) + max_new '
@@ -149,10 +161,33 @@ class Scheduler:
                 return 'active'
         return None
 
+    def _expire(self) -> list:
+        """Retire every request whose deadline passed: queued ones are
+        dropped (never seated — saturation starvation made visible);
+        active ones are evicted mid-decode, partial tokens kept. Returns
+        ``[(Completion, where), ...]`` for the tick."""
+        now = time.monotonic()
+        expired = []
+        for pending in list(self._queue):
+            deadline = pending.request.deadline
+            if deadline is not None and now - pending.submitted >= deadline:
+                self._queue.remove(pending)
+                expired.append((self._complete(pending, [], 'expired'),
+                                'queued'))
+        for row, pending in list(self._seated.items()):
+            deadline = pending.request.deadline
+            if deadline is not None and now - pending.submitted >= deadline:
+                state = self.engine.evict(row)
+                del self._seated[row]
+                expired.append((self._complete(pending, list(state.tokens),
+                                               'expired'), 'active'))
+        return expired
+
     def step(self) -> Tick:
-        """One serving iteration: admit within the prefill budget, then
-        decode every seated row once."""
+        """One serving iteration: expire past-deadline requests, admit
+        within the prefill budget, then decode every seated row once."""
         self.steps += 1
+        expired = self._expire()
         admitted, completed = [], []
         budget = self.prefill_budget
         while self._queue:
@@ -191,7 +226,7 @@ class Scheduler:
                 completed.append(self._complete(pending, list(tokens),
                                                 reason))
         return Tick(admitted, emitted, completed, len(self._queue),
-                    len(self._seated))
+                    len(self._seated), expired)
 
     def _complete(self, pending: _Pending, tokens: list,
                   reason: str) -> Completion:

@@ -20,7 +20,8 @@ from __future__ import annotations
 import time
 
 from tpusystem.observe.events import (RequestAdmitted, RequestCompleted,
-                                      RequestEvicted, ServeStepped)
+                                      RequestEvicted, RequestExpired,
+                                      ServeStepped)
 from tpusystem.serve.engine import Engine
 from tpusystem.serve.scheduler import Request, Scheduler, serve_levers
 from tpusystem.services.prodcon import Producer
@@ -87,6 +88,11 @@ class InferenceService:
         if self._started is None:
             self._started = time.monotonic()
         tick = self.scheduler.step()
+        for completion, where in tick.expired:
+            self.producer.dispatch(RequestExpired(
+                id=completion.request.id, where=where,
+                produced=len(completion.tokens),
+                waited=completion.seconds))
         for request, admission, ttft in tick.admitted:
             self.producer.dispatch(RequestAdmitted(
                 id=request.id, row=admission.row,
