@@ -20,6 +20,9 @@ config plus the per-step streamed weight bytes auto-vs-int8 — the
 roofline lever, ``benchmarks/decode_roofline.py``), then the
 ``serve_tok_s`` row (continuous batching vs static padded batching
 through the serving engine, ``benchmarks/serve_bench.py headline``),
+then the ``embedding_lookup_speedup`` row (the recommender workload's
+fused Pallas lookup vs the ``jnp.take`` fallback,
+``benchmarks/embedding_bench.py headline``),
 then the headline as the LAST JSON line (the one the driver parses):
 ``{"metric": ..., "value": N, "spread": N, "unit": ..., "vs_baseline": N}``.
 
@@ -123,6 +126,14 @@ def resize_seconds_row() -> None:
     `tpusystem/parallel/elastic.py` performs instead of a cold
     full-world restart)."""
     _overlap_probe_row('elastic_resize.py', 'resize_seconds')
+
+
+def embedding_row() -> None:
+    """The recommender-workload lookup row: fused Pallas row-gather /
+    grad scatter-add vs the ``jnp.take`` fallback at the headline
+    table shape (`benchmarks/embedding_bench.py headline`; CPU numbers
+    are interpreter-mode smoke — parity, not performance)."""
+    _overlap_probe_row('embedding_bench.py', 'embedding_lookup_speedup')
 
 
 def serve_row() -> None:
@@ -404,4 +415,5 @@ if __name__ == '__main__':
     resize_seconds_row()
     decode_rows()
     serve_row()
+    embedding_row()
     main()

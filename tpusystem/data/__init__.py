@@ -1,6 +1,7 @@
 from tpusystem.data.loader import ArrayDataset, Loader
-from tpusystem.data.datasets import (MemmapTokens, SyntheticDigits,
-                                     SyntheticTokens, TorchDataset)
+from tpusystem.data.datasets import (MemmapTokens, SyntheticClicks,
+                                     SyntheticDigits, SyntheticTokens,
+                                     TorchDataset)
 
-__all__ = ['ArrayDataset', 'Loader', 'MemmapTokens', 'SyntheticDigits',
-           'SyntheticTokens', 'TorchDataset']
+__all__ = ['ArrayDataset', 'Loader', 'MemmapTokens', 'SyntheticClicks',
+           'SyntheticDigits', 'SyntheticTokens', 'TorchDataset']

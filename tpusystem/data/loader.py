@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from collections.abc import Iterator, Sequence
+from collections.abc import Iterator
 from typing import Any
 
 import jax
@@ -65,7 +65,12 @@ class Loader:
 
     Args:
         dataset: :class:`ArrayDataset` or any object with ``__len__`` and
-            numpy fancy-indexing ``__getitem__``.
+            numpy fancy-indexing ``__getitem__``. Batches may be any
+            **pytree** of arrays sharing the leading batch dimension
+            (tuples, dict-of-arrays with ragged/multi-hot sparse fields,
+            nested mixes) — the prefetch thread and the ``state()``/
+            ``seek()`` cursors are structure-agnostic: the cursor names
+            batch *positions*, never batch contents.
         batch_size: per-iteration **global** batch size.
         shuffle: reshuffle each epoch with a per-epoch derived seed.
         seed: base shuffle seed (captured in identity).
@@ -134,13 +139,27 @@ class Loader:
             rng.shuffle(indices)
         return indices
 
-    def _place(self, batch: Sequence[np.ndarray]):
-        if self.sharding is not None:
-            return tuple(jax.device_put(part, self.sharding) for part in batch)
-        return tuple(jax.device_put(part) for part in batch)
+    def _place(self, batch):
+        """Device-place a batch **pytree** leaf by leaf.
 
-    def __iter__(self) -> Iterator[tuple]:
-        """Yield device-placed batches, prepared by a background thread.
+        Batches are whatever the dataset's ``__getitem__`` returns —
+        parallel-array tuples (:class:`ArrayDataset`), or arbitrary
+        pytrees like the dict-of-arrays click batches with ragged
+        (``-1``-padded) multi-hot sparse fields
+        (:class:`~tpusystem.data.datasets.SyntheticClicks`). The
+        ``sharding`` applies to every leaf: a batch-dim
+        ``PartitionSpec`` (rank <= the leaf's) shards dim 0 of dense
+        ``[B, d]``, sparse ``[B, F, K]`` and label ``[B]`` leaves alike,
+        so a heterogeneous global batch lands pre-sharded.
+        ``jax.device_put`` is natively pytree-aware (one batched
+        transfer, the sharding broadcast to every leaf)."""
+        if self.sharding is not None:
+            return jax.device_put(batch, self.sharding)
+        return jax.device_put(batch)
+
+    def __iter__(self) -> Iterator:
+        """Yield device-placed batch pytrees, prepared by a background
+        thread.
 
         Host-side batch prep — the ``dataset[span]`` gather plus the
         (asynchronous) ``device_put`` — runs in a prefetch thread, so

@@ -1,0 +1,192 @@
+"""DLRM-style recommender models (the second "real workload" family).
+
+The LLM families stress dense compute; these stress everything else —
+huge sparse lookups into row-sharded tables
+(:class:`tpusystem.recsys.ShardedEmbedding`), tiny dense MLPs, and heavy
+multi-hot input pipelines. Two variants:
+
+* :class:`DLRM` — the Meta DLRM shape (Naumov et al., 2019): dense
+  features through a bottom MLP, multi-hot sparse features pooled from
+  sharded embedding tables, pairwise dot-product feature interactions,
+  a small top MLP onto one click logit. Trained with
+  :class:`tpusystem.train.BCEWithLogitsLoss` through the ordinary
+  ``build_train_step``/policy machinery — DP batch sharding composes
+  with table row-sharding on the same mesh.
+
+* :class:`TwoTower` — the retrieval shape: user and item towers over
+  their own sharded tables, L2-normalized, scored against each other.
+  ``__call__`` returns the in-batch ``[B, B]`` score matrix (sampled
+  softmax training with ``targets = arange(B)``; recall@k eval reads
+  the same matrix).
+
+Both ship their ``partition_rules()`` (tables row-sharded via
+:func:`tpusystem.parallel.sharding.table_row_spec`; the dense MLPs are
+small enough to replicate) so ``TensorParallel``/``ShardingPolicy``
+places them without per-experiment configuration. All dense math is
+float32 — at these widths the MXU is never the bottleneck, the tables
+are.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax.sharding import PartitionSpec as P
+
+from tpusystem.recsys.embedding import ShardedEmbedding
+from tpusystem.registry import register
+
+
+class _MLP(nn.Module):
+    """Plain relu MLP (hidden widths then a linear head of ``out`` units)."""
+
+    widths: Sequence[int]
+    out: int
+
+    @nn.compact
+    def __call__(self, hidden):
+        for index, width in enumerate(self.widths):
+            hidden = nn.relu(nn.Dense(width, name=f'fc_{index}')(hidden))
+        return nn.Dense(self.out, name='head')(hidden)
+
+
+class DLRM(nn.Module):
+    """Deep Learning Recommendation Model over sharded embedding tables.
+
+    ``__call__(batch)`` takes a pytree batch (the shape
+    :class:`tpusystem.data.SyntheticClicks` yields)::
+
+        {'dense': [B, dense_features] float,
+         'ids':   [B, features, hot] int32, -1-padded multi-hot,
+         'weights': [B, features, hot] float (optional per-id weights)}
+
+    and returns ``[B]`` click logits. Sparse feature *f* looks up table
+    *f* (its own vocab), pools the hot rows by summation (padded ids
+    contribute exact zero rows), and the ``1 + features`` vectors
+    (bottom-MLP output first) interact via their pairwise dot products —
+    the DLRM interaction arch — before the top MLP.
+
+    Attributes:
+        vocabs: per-sparse-feature table sizes.
+        dim: embedding dimension (shared — interactions need one width).
+        dense_features: width of the dense input slice (shape check).
+        bottom: bottom-MLP hidden widths (output is always ``dim``).
+        top: top-MLP hidden widths (output is always one logit).
+        mesh: mesh whose ``expert``/``model`` axes row-shard the tables.
+        impl / dedup: lookup knobs, threaded to every table
+            (:class:`~tpusystem.recsys.ShardedEmbedding`).
+    """
+
+    vocabs: Sequence[int] = (128, 64)
+    dim: int = 16
+    dense_features: int = 4
+    bottom: Sequence[int] = (32,)
+    top: Sequence[int] = (32,)
+    mesh: object = None
+    impl: str = 'auto'
+    dedup: bool = True
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        dense = jnp.asarray(batch['dense'], jnp.float32)
+        ids = batch['ids']
+        weights = batch.get('weights') if hasattr(batch, 'get') else None
+        assert dense.shape[-1] == self.dense_features, (
+            f'dense slice is {dense.shape[-1]} wide, '
+            f'model expects {self.dense_features}')
+        assert ids.shape[1] == len(self.vocabs), (
+            f'batch carries {ids.shape[1]} sparse features, '
+            f'model has {len(self.vocabs)} tables')
+
+        bottom = _MLP(self.bottom, self.dim, name='bottom')(dense)
+        vectors = [bottom]
+        for feature, vocab in enumerate(self.vocabs):
+            rows = ShardedEmbedding(
+                vocab, self.dim, mesh=self.mesh, impl=self.impl,
+                dedup=self.dedup, name=f'table_{feature}')(
+                    ids[:, feature],
+                    None if weights is None else weights[:, feature])
+            vectors.append(jnp.sum(rows, axis=1))   # padded rows are zero
+        stacked = jnp.stack(vectors, axis=1)        # [B, 1+F, dim]
+        # pairwise dot-product interactions, strictly-lower triangle
+        inter = jnp.einsum('btd,bsd->bts', stacked, stacked)
+        lower = np.tril_indices(stacked.shape[1], k=-1)
+        tri = inter[:, lower[0], lower[1]]
+        logits = _MLP(self.top, 1, name='top')(
+            jnp.concatenate([bottom, tri], axis=-1))
+        return logits[:, 0]
+
+    @staticmethod
+    def partition_rules():
+        """Tables row-sharded over the combined ``expert``/``model``
+        axes (:func:`~tpusystem.parallel.sharding.table_row_spec`); the
+        tiny MLPs stay replicated (combine with ``fsdp=True`` on the
+        policy to scatter them anyway)."""
+        from tpusystem.parallel.sharding import table_row_spec
+        return ((r'table_\d+/embedding$', table_row_spec(2)),)
+
+
+register(DLRM, excluded_kwargs={'mesh'})
+
+
+class TwoTower(nn.Module):
+    """Two-tower retrieval model over sharded user/item tables.
+
+    ``__call__({'user': [B] or [B, K] ids, 'item': [B] ids})`` embeds
+    each side (multi-hot user histories pool by mean), runs it through
+    its tower MLP, L2-normalizes, and returns the in-batch ``[B, B]``
+    score matrix ``scores[i, j] = <user_i, item_j> / temperature`` —
+    train it as a B-way classification with ``targets = arange(B)``
+    (in-batch sampled softmax) and evaluate recall@k on the same matrix.
+    """
+
+    users: int = 256
+    items: int = 128
+    dim: int = 16
+    tower: Sequence[int] = (32,)
+    temperature: float = 0.05
+    mesh: object = None
+    impl: str = 'auto'
+    dedup: bool = True
+
+    def _tower(self, name: str, vocab: int, ids):
+        rows = ShardedEmbedding(vocab, self.dim, mesh=self.mesh,
+                                impl=self.impl, dedup=self.dedup,
+                                name=f'{name}_table')(ids)
+        if rows.ndim == 3:                          # multi-hot history
+            count = jnp.sum((ids >= 0).astype(jnp.float32), axis=1)
+            rows = jnp.sum(rows, axis=1) / jnp.maximum(count, 1.0)[:, None]
+        vector = _MLP(self.tower, self.dim, name=f'{name}_tower')(rows)
+        norm = jnp.sqrt(jnp.sum(vector * vector, axis=-1, keepdims=True))
+        return vector / jnp.maximum(norm, 1e-6)
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        user = self._tower('user', self.users, batch['user'])
+        item = self._tower('item', self.items, batch['item'])
+        return (user @ item.T) / self.temperature
+
+    @staticmethod
+    def partition_rules():
+        from tpusystem.parallel.sharding import table_row_spec
+        return ((r'(user|item)_table/embedding$', table_row_spec(2)),)
+
+
+register(TwoTower, excluded_kwargs={'mesh'})
+
+
+def dlrm_tiny(**overrides) -> DLRM:
+    """Test/dry-run scale: compiles in seconds on CPU."""
+    config = dict(vocabs=(64, 32), dim=8, dense_features=4,
+                  bottom=(16,), top=(16,))
+    config.update(overrides)
+    return DLRM(**config)
+
+
+def two_tower_tiny(**overrides) -> TwoTower:
+    config = dict(users=64, items=32, dim=8, tower=(16,))
+    config.update(overrides)
+    return TwoTower(**config)

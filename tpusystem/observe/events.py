@@ -48,6 +48,17 @@ class StepTimed:
         return self.steps / self.seconds if self.seconds else 0.0
 
 
+@event
+class RecsysEvaluated:
+    """The streaming recommender evaluator finished a phase-cadence pass
+    over its held-out loader (:class:`tpusystem.recsys.RecsysEvaluator`
+    via ``evaluation_consumer``); ``metrics`` carries materialized
+    floats — ``auc``/``loss`` for click models, ``recall@k`` for
+    retrieval models."""
+    model: Any
+    metrics: dict[str, float]
+
+
 # --------------------------------------------------------------------------
 # sentinel events — every rung of the divergence-escalation ladder
 # (tpusystem.train.sentinel) is a domain event, so the hash-chain ledger

@@ -28,10 +28,10 @@ import time
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
                                       ElasticTimeline, RecoveryTimeline,
-                                      ReplicaDiverged, RequestAdmitted,
-                                      RequestExpired, RolledBack,
-                                      ServeStepped, Trained, Validated,
-                                      WorkerExited, WorldResized)
+                                      RecsysEvaluated, ReplicaDiverged,
+                                      RequestAdmitted, RequestExpired,
+                                      RolledBack, ServeStepped, Trained,
+                                      Validated, WorkerExited, WorldResized)
 from tpusystem.services.prodcon import Consumer, Depends
 
 # ---------------------------------------------------------------- crc32c ---
@@ -167,6 +167,18 @@ def tensorboard_consumer() -> Consumer:
 
     def _subject(model) -> str:
         return str(getattr(model, 'id', model))
+
+    # recommender quality at phase cadence: the streaming evaluator's
+    # rank metrics (auc / recall@k) charted per epoch next to the loss,
+    # so a ranking regression reads straight off the dashboard
+
+    @consumer.handler
+    def on_recsys_evaluated(event: RecsysEvaluated,
+                            board: SummaryWriter = Depends(writer)) -> None:
+        epoch = getattr(event.model, 'epoch', 0)
+        for name, value in event.metrics.items():
+            board.add_scalar(f'{_subject(event.model)}/recsys/{name}',
+                             value, epoch)
 
     # sentinel ladder: each transition charted at its global step, so a
     # loss-spike investigation reads straight off the run's dashboard

@@ -27,10 +27,47 @@ from typing import Any, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from tpusystem.parallel.mesh import EXPERT, FSDP
+from tpusystem.parallel.mesh import EXPERT, FSDP, MODEL
 from tpusystem.registry import register
 
 Rules = Sequence[tuple[str, PartitionSpec]]
+
+# The embedding-table sharding axes: recommender tables row-shard their
+# vocab dimension over the combined (expert, model) axes — the two axes
+# the LLM policies reserve for weight splitting, which a
+# params-dominated sparse workload repurposes for table rows.
+TABLE_AXES = (EXPERT, MODEL)
+
+
+def table_row_spec(rank: int) -> PartitionSpec:
+    """Spec for a row-sharded embedding table: the leading (vocab)
+    dimension splits over the combined ``expert``/``model`` axes, every
+    other dim stays unsharded. The expert-major shard order (expert
+    index major, model index minor) is the contract the device-side id
+    routing in :mod:`tpusystem.recsys.embedding` derives offsets from."""
+    return PartitionSpec(TABLE_AXES, *([None] * (rank - 1)))
+
+
+def constrain_table_rows(value, mesh):
+    """Pin a row-sharded table (or table-shaped activation) to the
+    ``expert``/``model`` axes (no-op off-mesh or when both are size 1).
+
+    The :func:`constrain_expert_major` sibling for the recommender
+    workload — the single annotation point
+    :class:`tpusystem.recsys.ShardedEmbedding` applies to the table
+    right before its routed ``shard_map``, so GSPMD holds the param
+    row-sharded up to the manual boundary (no reshard) instead of
+    choosing its own layout. Axes absent from a hand-built mesh are
+    dropped (a ``MeshSpec`` mesh always carries all six at size >= 1)."""
+    if mesh is None:
+        return value
+    present = tuple(axis for axis in TABLE_AXES
+                    if axis in mesh.axis_names)
+    if all(mesh.shape[axis] == 1 for axis in present):
+        return value
+    spec = PartitionSpec(present, *([None] * (value.ndim - 1)))
+    return jax.lax.with_sharding_constraint(
+        value, NamedSharding(mesh, spec))
 
 
 def expert_major_spec(rank: int) -> PartitionSpec:

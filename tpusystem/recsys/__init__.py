@@ -1,0 +1,18 @@
+"""Recommender workload: sharded embedding tables + streaming eval.
+
+The second "real workload" family (ROADMAP item 5): huge sparse lookups
+into row-sharded tables, tiny dense compute, heavy multi-hot input
+pipelines — the stress profile the LLM paths never apply. The models
+themselves live with the rest of the zoo
+(:class:`tpusystem.models.DLRM` / :class:`~tpusystem.models.TwoTower`);
+this package owns the embedding tier and the rank-statistic evaluation.
+"""
+
+from tpusystem.recsys.embedding import (ShardedEmbedding, dedup_ids, lookup,
+                                        route_plan)
+from tpusystem.recsys.eval import (RecallAtK, RecsysEvaluator, StreamingAUC,
+                                   evaluation_consumer)
+
+__all__ = ['ShardedEmbedding', 'dedup_ids', 'lookup', 'route_plan',
+           'StreamingAUC', 'RecallAtK', 'RecsysEvaluator',
+           'evaluation_consumer']

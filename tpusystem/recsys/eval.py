@@ -1,0 +1,177 @@
+"""Streaming recommender evaluation at phase cadence from the event bus.
+
+Recommender metrics are rank statistics — AUC needs every (positive,
+negative) score pair, recall@k a per-query ranking — which the LM
+workloads' per-phase ``Mean``/``Perplexity`` accumulators cannot
+express. These evaluators keep the repo's cadence discipline anyway: a
+tiny jitted ``update`` per batch against device values (no host sync,
+no data-dependent Python), ONE ``device_get`` per phase in ``compute``.
+
+* :class:`StreamingAUC` — histogram-bucketed AUC over sigmoid scores:
+  ``update`` bins each batch's scores into fixed positive/negative
+  histograms on device; ``compute`` applies the rank-sum formula
+  (P(random positive > random negative), half credit for same-bucket
+  ties). Memory is O(buckets), resolution is 1/buckets — exact when
+  scores land on bucket centers, within ~1/buckets otherwise.
+
+* :class:`RecallAtK` — fraction of queries whose relevant item ranks in
+  the top k of the score row (the retrieval convention with one
+  relevant item per query — identical math to
+  :class:`~tpusystem.train.metrics.TopKAccuracy` over the two-tower
+  ``[B, B]`` in-batch score matrix).
+
+* :class:`RecsysEvaluator` — drives a held-out :class:`~tpusystem.data.
+  Loader` (pytree click batches riding the background prefetch thread)
+  through an eval step and both accumulators. Wire it to the bus with
+  :func:`evaluation_consumer`: the consumer reacts to each
+  :class:`~tpusystem.observe.events.Trained` — phase cadence, exactly
+  like the checkpoint and tensorboard consumers — and dispatches
+  :class:`~tpusystem.observe.events.RecsysEvaluated` with the
+  materialized metric floats, so the ledger/TB see recommender quality
+  without the training service knowing its observers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpusystem.observe.events import RecsysEvaluated, Trained
+from tpusystem.services import Consumer
+from tpusystem.train.metrics import Mean, TopKAccuracy
+
+
+@partial(jax.jit, static_argnames='buckets')
+def _auc_update(pos, neg, logits, targets, buckets: int):
+    scores = jax.nn.sigmoid(logits.reshape(-1).astype(jnp.float32))
+    index = jnp.clip((scores * buckets).astype(jnp.int32), 0, buckets - 1)
+    labels = targets.reshape(-1).astype(jnp.float32)
+    return pos.at[index].add(labels), neg.at[index].add(1.0 - labels)
+
+
+class StreamingAUC:
+    """Streaming ROC-AUC from histogrammed sigmoid scores.
+
+    ``update(logits, targets)`` bins one batch on device (targets are
+    0/1); ``compute`` syncs the two [buckets] histograms once and
+    returns the rank-sum AUC (0.5 when a class is absent)."""
+
+    def __init__(self, buckets: int = 512):
+        self.buckets = buckets
+        self.reset()
+
+    def reset(self) -> None:
+        self._pos = jnp.zeros((self.buckets,), jnp.float32)
+        self._neg = jnp.zeros((self.buckets,), jnp.float32)
+
+    def update(self, logits, targets) -> None:
+        self._pos, self._neg = _auc_update(self._pos, self._neg,
+                                           logits, targets, self.buckets)
+
+    def compute(self) -> float:
+        pos, neg = (np.asarray(part) for part in
+                    jax.device_get((self._pos, self._neg)))
+        positives, negatives = pos.sum(), neg.sum()
+        if positives == 0 or negatives == 0:
+            return 0.5
+        below = np.cumsum(neg) - neg         # negatives strictly below
+        wins = np.sum(pos * (below + 0.5 * neg))
+        return float(wins / (positives * negatives))
+
+
+class RecallAtK(TopKAccuracy):
+    """Recall@k over score rows with one relevant item per query
+    (``update(scores [B, C], relevant [B])``) — the retrieval reading of
+    top-k accuracy, named for the recsys convention."""
+
+
+class RecsysEvaluator:
+    """Held-out streaming eval: AUC (+ loss) for click models, recall@k
+    for retrieval models.
+
+    ``run(state)`` iterates the loader once (pytree batches, background
+    prefetch), feeds every batch through a jitted eval step, and updates
+    the accumulators on device; metrics materialize in one host sync at
+    the end. Which metrics apply follows the model's output rank: ``[B]``
+    click logits feed AUC, a ``[B, B]`` in-batch score matrix feeds
+    recall@k against the diagonal.
+    """
+
+    def __init__(self, module, loader, criterion=None, k: int = 10,
+                 buckets: int = 512):
+        from tpusystem.train import (BCEWithLogitsLoss, build_eval_step,
+                                     flax_apply)
+        self.loader = loader
+        self.k = k
+        # the default BCE criterion only means anything for [B] click
+        # logits — for a retrieval model pass the training criterion
+        # (e.g. CrossEntropyLoss) explicitly or no loss is reported
+        self._explicit_criterion = criterion is not None
+        self._step = build_eval_step(flax_apply(module),
+                                     criterion or BCEWithLogitsLoss())
+        self.auc = StreamingAUC(buckets)
+        self.recall = RecallAtK(k)
+        self.loss = Mean()
+
+    def run(self, state) -> dict[str, float]:
+        self.auc.reset()
+        self.recall.reset()
+        self.loss.reset()
+        ranked = False
+        for features, labels in self.loader:
+            outputs, loss = self._step(state, features, labels)
+            self.loss.update(loss)
+            if outputs.ndim == 2:            # [B, B] in-batch score matrix
+                ranked = True
+                self.recall.update(outputs,
+                                   jnp.arange(outputs.shape[0], dtype=jnp.int32))
+            else:
+                self.auc.update(outputs, labels)
+        if ranked:
+            # the default BCE loss is meaningless against a [B, B] score
+            # matrix — report it only when the caller supplied the
+            # criterion that matches the model's training objective
+            metrics = ({'loss': self.loss.compute()}
+                       if self._explicit_criterion else {})
+            metrics[f'recall@{self.k}'] = self.recall.compute()
+        else:
+            metrics = {'loss': self.loss.compute(),
+                       'auc': self.auc.compute()}
+        return metrics
+
+
+def evaluation_consumer(evaluator: RecsysEvaluator,
+                        state_of: Callable[[Any], Any] | None = None,
+                        producer=None, subject: Any = None):
+    """Consumer running the streaming eval at phase cadence.
+
+    Reacts to :class:`~tpusystem.observe.events.Trained` (the training
+    service dispatches one per train phase), pulls the current
+    ``TrainState`` off the aggregate (``state_of(model)``, default
+    ``model.state``), runs the evaluator, and — when ``producer`` is
+    given — dispatches :class:`~tpusystem.observe.events.RecsysEvaluated`
+    so downstream consumers (ledger, tensorboard) chart the metrics.
+
+    ``subject`` scopes the handler on a shared bus: pass the aggregate
+    instance (or its ``id``) this evaluator's module belongs to, and
+    ``Trained`` events from *other* models are ignored — the evaluator's
+    eval step is bound to one module, so another model's state would be
+    a param-tree mismatch. ``None`` (single-model buses) reacts to every
+    ``Trained``."""
+    state_of = state_of or (lambda model: model.state)
+    consumer = Consumer('recsys-eval')
+
+    @consumer.handler
+    def on_trained(event: Trained) -> None:
+        if subject is not None and event.model is not subject \
+                and getattr(event.model, 'id', None) != subject:
+            return
+        metrics = evaluator.run(state_of(event.model))
+        if producer is not None:
+            producer.dispatch(RecsysEvaluated(event.model, metrics))
+
+    return consumer

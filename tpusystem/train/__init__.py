@@ -4,8 +4,9 @@ from tpusystem.train.step import (build_1f1b_train_step, build_eval_step,
                                   build_train_step, flax_apply,
                                   grouped_batches, init_state)
 from tpusystem.train.optim import SGD, Adam, AdamW, Optimizer, masked_update
-from tpusystem.train.losses import (ChunkedNextTokenLoss, CrossEntropyLoss,
-                                    MSELoss, NextTokenLoss, WithAuxLoss)
+from tpusystem.train.losses import (BCEWithLogitsLoss, ChunkedNextTokenLoss,
+                                    CrossEntropyLoss, MSELoss, NextTokenLoss,
+                                    WithAuxLoss)
 from tpusystem.train.metrics import Accuracy, Mean, Metric, Perplexity, TopKAccuracy
 from tpusystem.train.generate import generate, speculative_generate
 from tpusystem.train.sentinel import (HEALTH_COLUMNS, DivergenceError, Guard,
@@ -17,7 +18,7 @@ __all__ = ['TrainState', 'HealthStats', 'resume_extras', 'build_train_step',
            'grouped_batches',
            'init_state', 'Optimizer', 'SGD', 'Adam', 'AdamW', 'masked_update',
            'CrossEntropyLoss', 'MSELoss', 'NextTokenLoss', 'ChunkedNextTokenLoss',
-           'WithAuxLoss',
+           'WithAuxLoss', 'BCEWithLogitsLoss',
            'Mean', 'Accuracy', 'TopKAccuracy', 'Perplexity', 'Metric',
            'generate', 'speculative_generate',
            'Guard', 'Sentinel', 'HEALTH_COLUMNS', 'DivergenceError']

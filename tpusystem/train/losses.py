@@ -43,6 +43,23 @@ class MSELoss:
 
 
 @register
+class BCEWithLogitsLoss:
+    """Binary cross-entropy on raw logits — the recommender workload's
+    click objective (:class:`tpusystem.models.DLRM` emits one logit per
+    example). Per-example mean, so gradient accumulation is exact
+    without a ``weight`` seam; targets are 0/1 floats (or bools)."""
+
+    def __init__(self):
+        ...
+
+    def __call__(self, logits, targets):
+        losses = optax.sigmoid_binary_cross_entropy(
+            logits.astype(jnp.float32),
+            jnp.asarray(targets, jnp.float32))
+        return jnp.mean(losses)
+
+
+@register
 class WithAuxLoss:
     """Wrap a criterion for models whose outputs are ``(predictions, aux)``
     — e.g. MoE models returning router load-balance losses
