@@ -7,6 +7,10 @@ TPU; see BASELINE.md "tp_overlap protocol"), then the
 ``fsdp_overlap_speedup_vs_gspmd`` row (the unified overlap scheduler's
 FSDP param-prefetch/grad-scatter hiding A/B,
 ``benchmarks/fsdp_overlap.py headline``, same protocol), then the
+``pp_overlap_speedup_vs_gspmd`` and ``moe_a2a_overlap_speedup`` rows
+(the scheduler's two new arms: skewed GPipe sends and pipelined expert
+all-to-all, ``benchmarks/pp_overlap.py`` / ``moe_a2a_overlap.py``,
+BASELINE.md "pp/moe overlap protocol"), then the
 ``sentinel_overhead`` row (steps/s with the in-graph divergence guard on
 vs off — the < 2% budget tracked in BENCH_*.json from day one), then the
 ``recovery_seconds`` row (hot in-memory restore vs disk restore wall
@@ -116,6 +120,23 @@ def fsdp_overlap_row() -> None:
     overlap scheduler's second client, `parallel/schedule.py`; BASELINE.md
     "fsdp_overlap protocol")."""
     _overlap_probe_row('fsdp_overlap.py', 'fsdp_overlap_speedup_vs_gspmd')
+
+
+def pp_overlap_row() -> None:
+    """The pipeline p2p hiding row: skewed-overlap GPipe ticks (sends
+    issued under the next microbatch's stage compute, the schedule's
+    ``pp='overlap'`` arm) vs the classic post-compute sends
+    (`benchmarks/pp_overlap.py headline`; BASELINE.md "pp/moe overlap
+    protocol" — virtual-CPU numbers are smoke)."""
+    _overlap_probe_row('pp_overlap.py', 'pp_overlap_speedup_vs_gspmd')
+
+
+def moe_a2a_overlap_row() -> None:
+    """The MoE expert all-to-all hiding row: pipelined dispatch (piece
+    k+1's exchange under the expert matmuls of piece k, the schedule's
+    ``moe='overlap'`` arm) vs the one-shot whole-batch exchange
+    (`benchmarks/moe_a2a_overlap.py headline`; same protocol)."""
+    _overlap_probe_row('moe_a2a_overlap.py', 'moe_a2a_overlap_speedup')
 
 
 def resize_seconds_row() -> None:
@@ -410,6 +431,8 @@ def main() -> None:
 if __name__ == '__main__':
     tp_overlap_row()
     fsdp_overlap_row()
+    pp_overlap_row()
+    moe_a2a_overlap_row()
     sentinel_overhead_row()
     recovery_seconds_row()
     resize_seconds_row()

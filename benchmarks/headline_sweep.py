@@ -60,6 +60,57 @@ def safe(tag, **kw):
         print(json.dumps({'variant': tag, 'error': str(error)[:120]}))
 
 
+def flash_bwd(batch: int, seq: int, backward: str) -> float:
+    """Seconds per fwd+bwd of a flash-attention loss with the given
+    backward impl — the retired ``flash_backward_ab.py`` A/B, kept as a
+    section here now that the fused single-pass backward is the default
+    with working-set auto-routing (`ops/pallas/flash.py`)."""
+    from tpusystem.ops.pallas.flash import flash_attention
+
+    heads, head_dim, repeats = 12, 64, 20
+    rng = np.random.default_rng(0)
+    shape = (batch, seq, heads, head_dim)
+    q, k, v = (jnp.asarray(rng.normal(size=shape), jnp.bfloat16)
+               for _ in range(3))
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, causal=True, backward=backward)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    grad = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def run(q, k, v):
+        def body(i, carry):
+            dq, dk, dv = grad(q + carry[0] * 0, k, v)  # defeat hoisting
+            return dq, dk, dv
+        return jax.lax.fori_loop(0, repeats, body, (q, k, v))
+
+    out = run(q, k, v)
+    float(out[0].astype(jnp.float32).sum())  # force completion via relay
+    start = time.perf_counter()
+    out = run(q, k, v)
+    float(out[0].astype(jnp.float32).sum())
+    return (time.perf_counter() - start) / repeats
+
+
+def flash_bwd_section():
+    """Split-vs-fused flash backward at the headline + long-context
+    shapes; one JSON line per shape."""
+    for batch, seq in [(16, 1024), (4, 4096), (2, 8192), (1, 16384)]:
+        try:
+            split = flash_bwd(batch, seq, 'split')
+            fused = flash_bwd(batch, seq, 'fused')
+            print(json.dumps({
+                'variant': f'flash_bwd b{batch} s{seq}',
+                'split_ms': round(split * 1e3, 3),
+                'fused_ms': round(fused * 1e3, 3),
+                'fused_speedup': round(split / fused, 3)}))
+        except Exception as error:
+            print(json.dumps({'variant': f'flash_bwd b{batch} s{seq}',
+                              'error': str(error)[:120]}))
+
+
 def set_flash_tiles(block_q: int, block_kv: int):
     """Point the module-level kernel entry at a tile-pinned wrapper (the
     model families call ``flash_attention`` with defaults; ``attend``
@@ -90,6 +141,11 @@ if __name__ == '__main__':
                         safe(f'b{batch} t{block_q}/{block_kv} '
                              f's{steps} c{chunks}',
                              batch=batch, steps=steps, chunks=chunks)
+    elif 'flash_bwd' in sys.argv[1:]:
+        # the retired flash_backward_ab.py A/B: fused single-pass
+        # dq+dk+dv backward vs the split dq/dkv pair, headline +
+        # long-context shapes on the real chip
+        flash_bwd_section()
     elif 'long' in sys.argv[1:]:
         # long-context ladder (BASELINE.md): 125M body, remat + fused loss
         # + flash, constant 16k tokens per step

@@ -583,3 +583,300 @@ def test_compile_guard_scheduled_step_never_retraces():
         f'scheduled train step retraced: {len(traces)} traces for 3 steps')
     if hasattr(runner, '_cache_size'):    # recompile guard, where exposed
         assert runner._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# the pp= and moe= arms: pipeline p2p + expert all-to-all under the schedule
+# ---------------------------------------------------------------------------
+
+
+from tpusystem.models import GPT2Pipelined, gpt2_tiny  # noqa: E402
+from tpusystem.parallel import (PipelineParallel, moe_plan,  # noqa: E402
+                                pipeline_apply, pp_plan)
+from tpusystem.parallel.mesh import partial_manual_skip_reason  # noqa: E402
+from tpusystem.train import (AdamW, NextTokenLoss, WithAuxLoss,  # noqa: E402
+                             build_train_step, flax_apply, init_state)
+
+_PARTIAL_MANUAL_REASON = partial_manual_skip_reason()
+needs_partial_manual = pytest.mark.skipif(
+    _PARTIAL_MANUAL_REASON is not None,
+    reason=_PARTIAL_MANUAL_REASON or 'partial-manual shard_map supported')
+
+
+def test_overlap_schedule_validates_the_new_arms():
+    with pytest.raises(ValueError, match='schedule pp'):
+        OverlapSchedule(pp='sometimes')
+    with pytest.raises(ValueError, match='schedule moe'):
+        OverlapSchedule(moe='magic')
+    # the new arms participate in identity and equality like the old ones
+    a = OverlapSchedule(pp='overlap', moe='overlap')
+    assert a != OverlapSchedule() and hash(a) != hash(OverlapSchedule())
+    assert 'pp=' in repr(a) and 'moe=' in repr(a)
+    # for_policy threads them through the policy pairing
+    policy = ShardingPolicy(rules=(), fsdp=True, fsdp_min_size=64)
+    paired = OverlapSchedule.for_policy(policy, tp='overlap', pp='overlap',
+                                        moe='overlap')
+    assert (paired.pp, paired.moe) == ('overlap', 'overlap')
+    assert paired.fsdp_min_size == 64
+    # the legacy-knob fold keeps both new arms on gspmd (old behavior)
+    legacy = resolve_schedule(None, 'overlap', 2)
+    assert (legacy.pp, legacy.moe) == ('gspmd', 'gspmd')
+
+
+def test_pp_plan_pins_paths():
+    # no stage axis: nothing to hide
+    plan = pp_plan(4, 1)
+    assert plan.path == 'skip' and 'axis_size' in plan.reason
+    # chunks that cannot tile the microbatch rows: classic ticks
+    plan = pp_plan(3, 4, chunks=2)
+    assert plan.path == 'one-shot' and 'chunks' in plan.reason
+    # the interleaved schedule owns its ticks
+    plan = pp_plan(4, 4, chunks=1, interleave=2)
+    assert plan.path == 'one-shot' and 'interleaved' in plan.reason
+    # plain GPipe with tiling rows: the skewed overlap schedule
+    plan = pp_plan(4, 4, chunks=2)
+    assert plan == pp_plan(4, 4, chunks=2)
+    assert plan.path == 'overlap' and plan.chunks == 2
+
+
+def test_moe_plan_pins_paths():
+    plan = moe_plan(8, 1)
+    assert plan.path == 'skip' and 'axis_size' in plan.reason
+    # ragged exchanges seat at the receiver: not pipelined today
+    for exchange in ('ragged', 'ragged-emulated'):
+        plan = moe_plan(8, 2, exchange=exchange)
+        assert plan.path == 'one-shot' and 'receiver' in plan.reason
+    # rows that won't split into pieces
+    plan = moe_plan(5, 2)
+    assert plan.path == 'one-shot' and 'split' in plan.reason
+    plan = moe_plan(8, 2)
+    assert plan.path == 'overlap' and plan.pieces == 2
+
+
+def _pp_stack():
+    layers, batch, dim = 8, 8, 16
+    keys = jax.random.split(jax.random.PRNGKey(0), layers)
+    weights = jax.vmap(lambda key: jax.random.normal(key, (dim, dim)) / dim)(
+        keys)
+    inputs = jax.random.normal(jax.random.PRNGKey(1), (batch, dim))
+    block_fn = lambda lp, x: jnp.tanh(x @ lp['w'])
+    return weights, inputs, block_fn
+
+
+@pytest.mark.parametrize('chunks', [1, 2])
+def test_pp_overlap_gpipe_is_bitwise_vs_classic(chunks):
+    """The skewed schedule computes identical math on identical operands
+    (the hops are pure copies), so outputs AND gradients are bitwise-
+    equal to the classic GPipe tick — in any dtype, the strongest form
+    of the f32-bitwise parity contract."""
+    mesh = MeshSpec(stage=4, data=2).build()
+    weights, inputs, block_fn = _pp_stack()
+    schedule = OverlapSchedule(pp='overlap', chunks=chunks)
+    assert pp_plan(2, 4, chunks=chunks).path == 'overlap'
+
+    classic = pipeline_apply(block_fn, {'w': weights}, inputs, mesh,
+                             microbatches=2)
+    skewed = pipeline_apply(block_fn, {'w': weights}, inputs, mesh,
+                            microbatches=2, schedule=schedule)
+    np.testing.assert_array_equal(np.asarray(classic), np.asarray(skewed))
+
+    def loss(sched):
+        def inner(w):
+            out = pipeline_apply(block_fn, {'w': w}, inputs, mesh,
+                                 microbatches=2, schedule=sched)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        return inner
+
+    g_classic = jax.jit(jax.grad(loss(None)))(weights)
+    g_skewed = jax.jit(jax.grad(loss(schedule)))(weights)
+    np.testing.assert_array_equal(np.asarray(g_classic),
+                                  np.asarray(g_skewed))
+
+
+def test_pp_overlap_fallback_when_chunks_cannot_tile():
+    """Microbatch rows that won't split into the requested chunks pin the
+    classic schedule (pp_plan) — and the run stays correct."""
+    mesh = MeshSpec(stage=4, data=2).build()
+    weights, inputs, block_fn = _pp_stack()
+    # local batch 4 over 2 microbatches = 2 rows; chunks=3 cannot tile
+    assert pp_plan(2, 4, chunks=3).path == 'one-shot'
+    schedule = OverlapSchedule(pp='overlap', chunks=3)
+    out = pipeline_apply(block_fn, {'w': weights}, inputs, mesh,
+                         microbatches=2, schedule=schedule)
+    reference = pipeline_apply(block_fn, {'w': weights}, inputs, mesh,
+                               microbatches=2)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(reference))
+
+
+def _moe_mesh():
+    return MeshSpec(data=2, expert=2).build(jax.devices()[:4])
+
+
+def _moe_tokens(seed=0, batch=8, seq=32):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, 256, (batch, seq)), jnp.int32)
+
+
+def _moe_loss_and_grads(schedule, mesh, tokens, **overrides):
+    config = dict(dim=64, heads=4, mesh=mesh, moe_experts=2, moe_every=2,
+                  moe_capacity_factor=2.0, dtype='float32',
+                  schedule=schedule)
+    config.update(overrides)
+    module = gpt2_tiny(**config)
+    optimizer = AdamW(lr=1e-3)
+    state = init_state(module, optimizer, tokens[:1], rng=0)
+    state = ShardingPolicy(rules=module.partition_rules()).place(state, mesh)
+    placed = jax.device_put(tokens, batch_sharding(mesh))
+    criterion = WithAuxLoss(NextTokenLoss())
+    apply_fn = flax_apply(module)
+
+    def loss(params):
+        return criterion(apply_fn(params, placed, None, True), placed)
+
+    value, grads = jax.jit(jax.value_and_grad(loss))(state.params)
+    return state.params, float(value), grads
+
+
+def test_moe_overlap_dispatch_matches_gspmd_model_level():
+    """moe='overlap' on the sharded quota path: the pipelined dispatch
+    (piece k+1's all_to_all under the expert matmuls of k) reproduces
+    the one-shot exchange — loss BITWISE in f32 at ample capacity
+    (routing runs unsplit; the FFN and combine are row-independent),
+    grads f32-tight (only backward summation order differs), identical
+    param trees."""
+    mesh = _moe_mesh()
+    tokens = _moe_tokens()
+    p_ref, l_ref, g_ref = _moe_loss_and_grads(None, mesh, tokens)
+    p_ovl, l_ovl, g_ovl = _moe_loss_and_grads(
+        OverlapSchedule(moe='overlap'), mesh, tokens)
+    assert (jax.tree_util.tree_structure(p_ref)
+            == jax.tree_util.tree_structure(p_ovl))
+    for ref, ovl in zip(jax.tree.leaves(p_ref), jax.tree.leaves(p_ovl)):
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(ovl))
+    assert l_ref == l_ovl, (l_ref, l_ovl)
+    for ref, ovl in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_ovl)):
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(ovl),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_moe_overlap_ragged_exchange_falls_back_one_shot():
+    """The ragged exchange keeps its single whole-batch exchange under
+    moe='overlap' (pinned by moe_plan) — the knob degrades to the
+    documented fallback instead of changing semantics or crashing."""
+    assert moe_plan(8, 2, exchange='ragged-emulated').path == 'one-shot'
+    mesh = _moe_mesh()
+    tokens = _moe_tokens(seed=1)
+    _, l_ref, _ = _moe_loss_and_grads(None, mesh, tokens,
+                                      moe_exchange='ragged-emulated')
+    _, l_ovl, _ = _moe_loss_and_grads(OverlapSchedule(moe='overlap'), mesh,
+                                      tokens,
+                                      moe_exchange='ragged-emulated')
+    assert l_ref == l_ovl, (l_ref, l_ovl)
+
+
+def _pipelined_moe_losses(schedule, mesh, tokens, steps=3, **overrides):
+    config = dict(vocab_size=256, layers=4, dim=48, heads=4, max_seq=64,
+                  dtype='float32', microbatches=2, mesh=mesh,
+                  moe_experts=2, moe_every=2, moe_capacity_factor=2.0,
+                  schedule=schedule)
+    config.update(overrides)
+    model = GPT2Pipelined(**config)
+    optimizer = AdamW(lr=1e-3)
+    state = init_state(model, optimizer, tokens[:1], rng=0)
+    state = PipelineParallel(
+        stacked_rules=GPT2Pipelined.block_partition_rules(),
+        fsdp=True, fsdp_min_size=64).place(state, mesh)
+    placed = jax.device_put(tokens, batch_sharding(mesh))
+    step = build_train_step(flax_apply(model), WithAuxLoss(NextTokenLoss()),
+                            optimizer)
+    losses = []
+    for _ in range(steps):
+        state, (_, loss) = step(state, placed, placed)
+        losses.append(float(loss))
+    return state, losses
+
+
+def test_composed_pp_fsdp_moe_pipelined_step_is_bitwise_vs_gspmd():
+    """The composed arms on a dp x fsdp x stage mesh (fully-manual
+    pipeline — runs on every jaxlib): a pipelined MoE GPT-2 under
+    OverlapSchedule(pp='overlap', fsdp='prefetch', moe='overlap') trains
+    BITWISE-equal losses and params to the all-GSPMD reference across 3
+    steps — pp reschedules pure copies; fsdp/moe arms degrade per their
+    plans inside the pipe (the blocks see mesh=None) and bite on the
+    non-pipelined meshes their own tests cover."""
+    mesh = MeshSpec(data=2, fsdp=2, stage=2).build()
+    tokens = _moe_tokens(seed=2, batch=16)
+    schedule = OverlapSchedule(tp='overlap', fsdp='prefetch', pp='overlap',
+                               moe='overlap', fsdp_min_size=64)
+    s_ref, l_ref = _pipelined_moe_losses(None, mesh, tokens)
+    s_ovl, l_ovl = _pipelined_moe_losses(schedule, mesh, tokens)
+    assert l_ref == l_ovl, (l_ref, l_ovl)
+    assert (jax.tree_util.tree_structure(s_ref.params)
+            == jax.tree_util.tree_structure(s_ovl.params))
+    for ref, ovl in zip(jax.tree.leaves(s_ref.params),
+                        jax.tree.leaves(s_ovl.params)):
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(ovl))
+
+
+@needs_partial_manual
+def test_composed_pp_tp_fsdp_moe_pipelined_step_matches_gspmd():
+    """The full four-axis composition (dp-free fsdp x model x stage mesh,
+    partial-manual pipeline: GSPMD partitions the stage bodies over
+    `model`): losses bitwise vs the all-GSPMD reference."""
+    mesh = MeshSpec(fsdp=2, model=2, stage=2).build()
+    tokens = _moe_tokens(seed=3, batch=16)
+    schedule = OverlapSchedule(tp='overlap', fsdp='prefetch', pp='overlap',
+                               moe='overlap', fsdp_min_size=64)
+    s_ref, l_ref = _pipelined_moe_losses(None, mesh, tokens)
+    s_ovl, l_ovl = _pipelined_moe_losses(schedule, mesh, tokens)
+    assert l_ref == l_ovl, (l_ref, l_ovl)
+    for ref, ovl in zip(jax.tree.leaves(s_ref.params),
+                        jax.tree.leaves(s_ovl.params)):
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(ovl))
+
+
+def test_compile_guard_composed_pipelined_step_never_retraces():
+    """The PR-1 pipeline retrace bug class, guarded for the new arms: the
+    composed pp/moe-scheduled train step traces exactly once across
+    steps."""
+    mesh = MeshSpec(data=2, fsdp=2, stage=2).build()
+    tokens = _moe_tokens(seed=4, batch=16)
+    schedule = OverlapSchedule(pp='overlap', moe='overlap',
+                               fsdp='prefetch', fsdp_min_size=64)
+    model = GPT2Pipelined(vocab_size=256, layers=4, dim=48, heads=4,
+                          max_seq=64, dtype='float32', microbatches=2,
+                          mesh=mesh, moe_experts=2, moe_every=2,
+                          moe_capacity_factor=2.0, schedule=schedule)
+    optimizer = AdamW(lr=1e-3)
+    state = init_state(model, optimizer, tokens[:1], rng=0)
+    state = PipelineParallel(fsdp=True, fsdp_min_size=64).place(state, mesh)
+    placed = jax.device_put(tokens, batch_sharding(mesh))
+    raw = build_train_step(flax_apply(model), WithAuxLoss(NextTokenLoss()),
+                           optimizer, jit=False)
+
+    traces = []
+
+    def counting_step(state, inputs, targets):
+        traces.append(1)          # runs at trace time only
+        return raw(state, inputs, targets)
+
+    runner = jax.jit(counting_step)
+    loss = None
+    for _ in range(3):
+        state, (_, loss) = runner(state, placed, placed)
+    assert np.isfinite(float(loss)), float(loss)
+    assert len(traces) == 1, (
+        f'composed pipelined step retraced: {len(traces)} traces for 3 steps')
+
+
+def test_pipelined_moe_rejects_1f1b_and_interleave():
+    from tpusystem.train import build_1f1b_train_step
+    mesh = MeshSpec(data=2, stage=2).build(jax.devices()[:4])
+    with pytest.raises(ValueError, match='interleave'):
+        GPT2Pipelined(vocab_size=64, layers=4, dim=32, heads=2, max_seq=32,
+                      mesh=mesh, moe_experts=2, interleave=2)
+    model = GPT2Pipelined(vocab_size=64, layers=4, dim=32, heads=2,
+                          max_seq=32, dtype='float32', microbatches=2,
+                          mesh=mesh, moe_experts=2)
+    with pytest.raises(ValueError, match='MoE spans'):
+        build_1f1b_train_step(model, NextTokenLoss(), AdamW(lr=1e-3))

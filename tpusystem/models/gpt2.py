@@ -144,12 +144,16 @@ class Block(nn.Module):
         normed = nn.LayerNorm(dtype=jnp.float32, name='ln_2')(hidden)
         if self.moe_experts:
             from tpusystem.ops.moe import MoEMLP
+            # the schedule's moe= arm reaches the expert dispatch here:
+            # with moe='overlap' the sharded quota exchange pipelines its
+            # all_to_all under the expert matmuls (ops/moe.py)
             shrunk, aux = MoEMLP(self.moe_experts, k=self.moe_k,
                                  mlp_ratio=self.mlp_ratio,
                                  capacity_factor=self.moe_capacity_factor,
                                  dtype=self.dtype, mesh=self.mesh,
                                  exchange=self.moe_exchange,
                                  sparse_impl=self.moe_sparse_impl,
+                                 schedule=schedule,
                                  name='moe')(normed.astype(self.dtype))
         else:
             from tpusystem.parallel.overlap import DenseParams
@@ -538,13 +542,24 @@ class GPT2Pipelined:
     builders expect from a flax module, so ``init_state``/``flax_apply``
     work unchanged. Dropout is 0 inside the pipe (pretraining-scale
     convention); the reference never pipelines at all (SURVEY.md §2.4).
+
+    ``schedule=OverlapSchedule(pp='overlap', ...)`` skews the GPipe loop
+    so every stage-to-stage ``ppermute`` issues under a microbatch's
+    compute (see :func:`tpusystem.parallel.pipeline.pipeline_apply`);
+    ``moe_experts > 0`` makes every ``moe_every``-th block an MoE FFN
+    (the stacked unit becomes a :class:`BlockSpan`, router aux losses
+    ride the pipeline's aux channel, and ``apply`` returns
+    ``(logits, aux)`` for ``WithAuxLoss`` — the GPipe path only; the
+    1F1B builder rejects MoE spans).
     """
 
     def __init__(self, vocab_size: int = 50257, layers: int = 12,
                  dim: int = 768, heads: int = 12, max_seq: int = 1024,
                  mlp_ratio: int = 4, dtype: str = 'bfloat16',
                  microbatches: int = 4, remat: bool = True, mesh=None,
-                 return_features: bool = False, interleave: int = 1):
+                 return_features: bool = False, interleave: int = 1,
+                 schedule=None, moe_experts: int = 0, moe_every: int = 2,
+                 moe_k: int = 2, moe_capacity_factor: float = 1.25):
         if mesh is None:
             raise ValueError('GPT2Pipelined needs a mesh with a stage axis')
         if layers % max(interleave, 1):
@@ -561,17 +576,53 @@ class GPT2Pipelined:
         # P(None, stage) sharding places each device's v non-contiguous
         # chunks without per-step resharding
         self.interleave = interleave
-        self.block = Block(heads, mlp_ratio, 0.0, jnp.dtype(dtype))
+        # schedule: parallel.OverlapSchedule — the pp= arm drives the
+        # GPipe loop's skewed overlap ticks (pipeline_apply); tp=/fsdp=
+        # stay on GSPMD inside stage bodies (the stage shard_map is
+        # already the manual region, so the blocks see mesh=None and the
+        # partial-manual model axis), and moe= reaches the blocks' MoEMLP
+        # (single-shard inside the pipe — the exchange arms bite on the
+        # non-pipelined expert meshes). Purely an implementation
+        # schedule: param trees and losses are bitwise knob-invariant.
+        self.schedule = schedule
+        # moe_experts > 0: every `moe_every`-th block is an expert-
+        # parallel MoEMLP. The stacked unit becomes a BlockSpan of
+        # `moe_every` blocks (the homogeneous span nn.scan/vmap needs),
+        # so the stage axis shards layers/moe_every spans; the router aux
+        # losses ride pipeline_apply's aux channel (mean over every
+        # (span, microbatch)) and the model returns (logits, aux) for
+        # WithAuxLoss, exactly like the non-pipelined family.
+        self.moe_experts = moe_experts
+        self.moe_every = moe_every
+        if moe_experts:
+            if interleave > 1:
+                raise ValueError('moe_experts with interleave > 1 is not '
+                                 'supported (the aux channel rides the '
+                                 'plain GPipe schedule)')
+            if layers % moe_every:
+                raise ValueError(f'{layers} layers not divisible by '
+                                 f'moe_every ({moe_every})')
+            self.block = BlockSpan(heads, mlp_ratio, 0.0, jnp.dtype(dtype),
+                                   span=moe_every, moe_experts=moe_experts,
+                                   moe_every=moe_every, moe_k=moe_k,
+                                   moe_capacity_factor=moe_capacity_factor,
+                                   schedule=schedule)
+            self.stacked_units = layers // moe_every
+        else:
+            self.block = Block(heads, mlp_ratio, 0.0, jnp.dtype(dtype),
+                               schedule=schedule)
+            self.stacked_units = layers
         self.stacked_key = 'h'   # params key of the stage-sharded layer stack
 
     def __call__(self, tokens, train: bool = False):
         raise TypeError('bind parameters via .apply(), like a flax module')
 
     def init(self, rng, tokens, train: bool = False):
-        keys = jax.random.split(rng, self.layers + 2)
+        units = self.stacked_units
+        keys = jax.random.split(rng, units + 2)
         sample = jnp.zeros((1, 8, self.dim), jnp.dtype(self.dtype))
         stacked = jax.vmap(lambda key: self.block.init(key, sample)['params'])(
-            keys[:self.layers])
+            keys[:units])
         if self.interleave > 1:
             stacked = jax.tree.map(
                 lambda leaf: leaf.reshape(
@@ -626,19 +677,45 @@ class GPT2Pipelined:
         hidden = self._embed(params, tokens)
         # chunk-major stack passes straight through: pipeline_apply's
         # interleaved forward schedule shares pipeline_train's layout, so
-        # the GPipe path gets the same (S-1)/v fill/drain bubble shrink
+        # the GPipe path gets the same (S-1)/v fill/drain bubble shrink.
+        # schedule.pp='overlap' swaps in the skewed tick (sends under
+        # compute); with MoE spans the router aux rides the aux channel.
         hidden = pipeline_apply(self._block_fn(), params['h'],
                                 hidden, self.mesh,
                                 microbatches=self.microbatches,
                                 remat=self.remat,
-                                interleave=self.interleave)
+                                interleave=self.interleave,
+                                schedule=self.schedule,
+                                has_aux=bool(self.moe_experts))
+        if self.moe_experts:
+            hidden, aux = hidden
+            return self._head(params, hidden), aux
         return self._head(params, hidden)
 
     def sequential_apply(self, variables, tokens):
-        """Reference forward without the pipeline (correctness harness)."""
+        """Reference forward without the pipeline (correctness harness).
+
+        With MoE spans the aux is the mean over span units computed on
+        the FULL batch — the pipelined aux averages per-microbatch span
+        means instead (the balance loss is nonlinear in its token
+        statistics, and expert capacity derives from the call's token
+        count), so with drops or across that nonlinearity the two agree
+        only approximately; schedule-on vs schedule-off pipelined runs
+        agree bitwise."""
         params = variables['params']
         hidden = self._embed(params, tokens)
         block_fn = self._block_fn()
+
+        if self.moe_experts:
+            def moe_layer(carry, layer_params):
+                x, aux = carry
+                x, unit_aux = block_fn(layer_params, x)
+                return (x, aux + unit_aux.astype(jnp.float32)), None
+            (hidden, aux_sum), _ = jax.lax.scan(
+                moe_layer, (hidden, jnp.float32(0)),
+                self._flat_stack(params['h']))
+            return (self._head(params, hidden),
+                    aux_sum / self.stacked_units)
 
         def layer(carry, layer_params):
             return block_fn(layer_params, carry), None

@@ -304,7 +304,10 @@ class Llama(nn.Module):
     schedule: object = None  # parallel.OverlapSchedule: ONE knob composing
     # the TP rings with FSDP param-prefetch/grad-scatter hiding (see
     # gpt2.GPT2.schedule); None keeps the legacy tp_impl=/tp_chunks=
-    # behavior. Param trees and checkpoints are bitwise knob-invariant
+    # behavior. The pp=/moe= arms ride the same object but are inert in
+    # this family (no pipelined/MoE Llama variant yet — pass the one
+    # schedule everywhere and each model consumes the arms it has).
+    # Param trees and checkpoints are bitwise knob-invariant
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):

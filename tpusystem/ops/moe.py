@@ -505,6 +505,20 @@ class MoEMLP(nn.Module):
     # parity with gather/scatter is NOT expected, only tolerance-bounded:
     # the MXU accumulates in f32 and sums in different orders)
     sparse_impl: str = 'gather'
+    # schedule: parallel.OverlapSchedule — its moe= arm governs the
+    # sharded quota dispatch. moe='overlap' splits the local token rows
+    # into microbatch pieces and software-pipelines the exchange: piece
+    # k+1's dispatch all_to_all issues UNDER the expert matmuls of piece
+    # k, and piece k's return exchange rides under the matmuls of k+1 —
+    # the expert a2a leaves the critical path the way the TP/FSDP rings
+    # did. Pure moe_plan (parallel/schedule.py) pins the one-shot
+    # fallback (ragged exchanges, rows that won't split); None or
+    # moe='gspmd' keeps the single whole-batch exchange. Routing runs on
+    # the full local rows either way (aux losses bitwise-invariant); per-
+    # piece quotas are the quota path's per-sender drop discipline at
+    # finer grain — with ample capacity (no drops) outputs are bitwise-
+    # equal to the one-shot path
+    schedule: object = None
 
     @nn.compact
     def __call__(self, hidden):
@@ -701,12 +715,27 @@ class MoEMLP(nn.Module):
         drops are decided per sender (choice-major within each shard), not
         by global token order — with ample capacity (no drops) the two
         paths agree exactly.
+
+        With ``schedule.moe='overlap'``
+        (:class:`~tpusystem.parallel.schedule.OverlapSchedule`, planned by
+        the pure :func:`~tpusystem.parallel.schedule.moe_plan`) the local
+        rows split into microbatch pieces and the exchanges software-
+        pipeline: piece ``k+1``'s dispatch ``all_to_all`` is issued
+        *before* piece ``k``'s expert matmuls in program order — the two
+        are dataflow-independent, so the transfer hides under the MXU
+        work — and piece ``k``'s return exchange rides under the matmuls
+        of ``k+1`` the same way. Routing runs on the full local rows
+        first (router logits/gates and the aux losses are bitwise
+        identical to the one-shot path); each piece seats into its own
+        per-piece quota (the per-sender drop discipline at finer grain:
+        with ample capacity, outputs are bitwise-equal to one-shot).
         """
         import functools
 
         from jax import lax
 
         from tpusystem.parallel.mesh import DATA, FSDP, SEQ
+        from tpusystem.parallel.schedule import MoePlan, moe_plan
 
         mesh = self.mesh
         expert_ax = mesh.shape[EXPERT]
@@ -722,8 +751,45 @@ class MoEMLP(nn.Module):
                                / self.experts)))
         dim = flat.shape[1]
         experts, k = self.experts, self.k
+        capacity_factor = self.capacity_factor
         row_axes = (DATA, FSDP, SEQ, EXPERT)
         row_spec = P(row_axes, None)
+        if (self.schedule is not None
+                and getattr(self.schedule, 'moe', 'gspmd') == 'overlap'):
+            plan = moe_plan(local_rows, expert_ax, self.exchange)
+        else:
+            plan = MoePlan('one-shot', 1, 'moe overlap inactive')
+
+        def exchange(buffer):
+            # chunk d of a send buffer (global expert order, owners
+            # contiguous) goes to device d; twice the same tiled exchange
+            # is the identity, which is how outputs come home
+            return lax.all_to_all(buffer, EXPERT, split_axis=0,
+                                  concat_axis=0, tiled=True)
+
+        def seat(rows_piece, gates_piece, piece_quota):
+            """Route one piece into its [experts * piece_quota, dim] send
+            buffer (choice-major per-sender seating — the path's one drop
+            discipline, at the piece's own quota)."""
+            token_ids, slots, weights, _ = route_top_k_sparse(
+                gates_piece, k, piece_quota)
+            send = jnp.zeros((experts * piece_quota, dim), compute)
+            send = send.at[slots].set(rows_piece.astype(compute)[token_ids],
+                                      mode='drop')
+            return send, (slots, token_ids, weights)
+
+        def expert_pass(recv, piece_quota, w1, b1, w2, b2):
+            """Seated arrivals -> expert FFN -> buffer-order returns."""
+            expert_in = (recv.reshape(expert_ax, local_experts,
+                                      piece_quota, dim)
+                         .transpose(1, 0, 2, 3)
+                         .reshape(local_experts, expert_ax * piece_quota,
+                                  dim))
+            shrunk = self._ffn(expert_in, w1, b1, w2, b2, compute)
+            return (shrunk.reshape(local_experts, expert_ax, piece_quota,
+                                   dim)
+                    .transpose(1, 0, 2, 3)
+                    .reshape(experts * piece_quota, dim))
 
         @functools.partial(
             shard_map, mesh=mesh, check_vma=False,
@@ -731,33 +797,58 @@ class MoEMLP(nn.Module):
                       P(EXPERT, None, None), P(EXPERT, None)),
             out_specs=(row_spec, P()))
         def run(rows, router, w1, b1, w2, b2):
+            # routing always runs on the FULL local rows: one logits
+            # matmul, bitwise-identical gates and aux losses under either
+            # dispatch schedule — only the seating/exchange is per-piece
             logits = rows.astype(jnp.float32) @ router
             gates = jax.nn.softmax(logits)
-            token_ids, slots, weights, fraction = route_top_k_sparse(
-                gates, k, quota)
 
-            send = jnp.zeros((experts * quota, dim), compute)
-            send = send.at[slots].set(rows.astype(compute)[token_ids],
-                                      mode='drop')
-            # chunk d of the send buffer (global expert order, owners
-            # contiguous) goes to device d; twice the same tiled exchange
-            # is the identity, which is how outputs come home below
-            recv = lax.all_to_all(send, EXPERT, split_axis=0, concat_axis=0,
-                                  tiled=True)
-            expert_in = (recv.reshape(expert_ax, local_experts, quota, dim)
-                         .transpose(1, 0, 2, 3)
-                         .reshape(local_experts, expert_ax * quota, dim))
-
-            shrunk = self._ffn(expert_in, w1, b1, w2, b2, compute)
-
-            back = (shrunk.reshape(local_experts, expert_ax, quota, dim)
-                    .transpose(1, 0, 2, 3)
-                    .reshape(experts * quota, dim))
-            buffer = lax.all_to_all(back, EXPERT, split_axis=0, concat_axis=0,
-                                    tiled=True)
-            output = self._sparse_combine(buffer, slots, token_ids,
-                                          weights, rows.shape[0], dim,
-                                          compute)
+            if plan.path == 'overlap':
+                pieces = plan.pieces
+                piece_rows = rows.shape[0] // pieces
+                piece_quota = max(1, min(piece_rows,
+                                         int(piece_rows * k
+                                             * capacity_factor / experts)))
+                routed = [
+                    seat(lax.dynamic_slice_in_dim(rows, p * piece_rows,
+                                                  piece_rows),
+                         lax.dynamic_slice_in_dim(gates, p * piece_rows,
+                                                  piece_rows),
+                         piece_quota)
+                    for p in range(pieces)]
+                # the software pipeline: piece p+1's dispatch a2a issues
+                # BEFORE piece p's expert matmuls (independent, so the
+                # transfer hides under the MXU work); piece p's return
+                # a2a issues after its matmuls and completes under p+1's
+                recv = [None] * pieces
+                recv[0] = exchange(routed[0][0])
+                outs = []
+                for p in range(pieces):
+                    if p + 1 < pieces:
+                        recv[p + 1] = exchange(routed[p + 1][0])
+                    back = expert_pass(recv[p], piece_quota, w1, b1, w2, b2)
+                    buffer = exchange(back)
+                    slots, token_ids, weights = routed[p][1]
+                    outs.append(self._sparse_combine(
+                        buffer, slots, token_ids, weights, piece_rows, dim,
+                        compute))
+                output = jnp.concatenate(outs, axis=0)
+                # the load-balance fraction, exactly as route_top_k_sparse
+                # computes it, from the full gates
+                _, top_experts = jax.lax.top_k(gates, k)
+                fraction = jnp.mean(jax.nn.one_hot(top_experts[:, 0],
+                                                   experts), axis=0)
+            else:
+                token_ids, slots, weights, fraction = route_top_k_sparse(
+                    gates, k, quota)
+                send = jnp.zeros((experts * quota, dim), compute)
+                send = send.at[slots].set(rows.astype(compute)[token_ids],
+                                          mode='drop')
+                buffer = exchange(expert_pass(exchange(send), quota,
+                                              w1, b1, w2, b2))
+                output = self._sparse_combine(buffer, slots, token_ids,
+                                              weights, rows.shape[0], dim,
+                                              compute)
 
             # Switch balance/z losses over GLOBAL token statistics
             fraction = lax.pmean(fraction, row_axes)

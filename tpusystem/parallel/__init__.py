@@ -11,7 +11,7 @@ from tpusystem.parallel.multihost import (
 )
 from tpusystem.parallel.collectives import (
     all_gather, all_reduce_mean, all_reduce_sum, all_to_all, axis_index,
-    axis_size, reduce_scatter, replica_checksums, ring_allgather,
+    axis_size, pp_hop, reduce_scatter, replica_checksums, ring_allgather,
     ring_reducescatter, ring_shift, ring_shift_chunked,
 )
 from tpusystem.parallel.overlap import (
@@ -19,8 +19,9 @@ from tpusystem.parallel.overlap import (
     reducescatter_plan, tp_ffn, tp_swiglu,
 )
 from tpusystem.parallel.schedule import (
-    FsdpPlan, OverlapSchedule, fsdp_plan, resolve_schedule,
-    schedule_applicable, scheduled_ffn, scheduled_swiglu,
+    FsdpPlan, MoePlan, OverlapSchedule, PpPlan, fsdp_plan, moe_plan,
+    pp_plan, resolve_schedule, schedule_applicable, scheduled_ffn,
+    scheduled_swiglu,
 )
 from tpusystem.parallel.pipeline import (PipelineParallel,
                                          compose_stacked_rules,
@@ -72,6 +73,7 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'replica_checksums',
            'allgather_matmul', 'matmul_reducescatter',
            'allgather_plan', 'reducescatter_plan', 'tp_ffn', 'tp_swiglu',
-           'ring_allgather', 'ring_reducescatter',
+           'ring_allgather', 'ring_reducescatter', 'pp_hop',
            'OverlapSchedule', 'FsdpPlan', 'fsdp_plan', 'resolve_schedule',
+           'PpPlan', 'pp_plan', 'MoePlan', 'moe_plan',
            'schedule_applicable', 'scheduled_ffn', 'scheduled_swiglu']

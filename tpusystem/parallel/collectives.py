@@ -87,6 +87,38 @@ def ring_shift_chunked(value, axis: str, *, chunks: int = 1,
     return jnp.concatenate(shifted, axis=0)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def pp_hop(axis, chunks, value):
+    """One pipeline stage-to-stage hop: the forward chunked ring shift
+    with an explicit transpose, so autodiff of a pipelined schedule
+    issues *reversed* chunked sends.
+
+    Forward is exactly :func:`ring_shift_chunked` (rank ``i`` sends to
+    ``(i + 1) % n``); the custom backward is the reverse chunked shift of
+    the cotangent (rank ``i`` sends to ``(i - 1) % n``) — a pure copy in
+    both directions, bitwise-exact in any dtype. The value of the
+    custom_vjp is *placement*: under the skewed GPipe schedule
+    (``pp='overlap'`` in :class:`~tpusystem.parallel.schedule
+    .OverlapSchedule`) the forward hop is issued at tick top, before the
+    stage compute that hides it, and autodiff transposes that structure —
+    the reversed send of backward tick ``t`` is independent of tick
+    ``t``'s block vjp matmuls, so it hides under them instead of
+    serializing the reversed ring.
+    """
+    return ring_shift_chunked(value, axis, chunks=chunks)
+
+
+def _pp_hop_fwd(axis, chunks, value):
+    return pp_hop(axis, chunks, value), None
+
+
+def _pp_hop_bwd(axis, chunks, _, grad):
+    return (ring_shift_chunked(grad, axis, chunks=chunks, reverse=True),)
+
+
+pp_hop.defvjp(_pp_hop_fwd, _pp_hop_bwd)
+
+
 def ring_allgather(value, axis: str, *, dimension: int = 0,
                    chunks: int = 1):
     """:func:`all_gather` decomposed into ``axis_size`` ring steps.

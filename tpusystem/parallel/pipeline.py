@@ -113,11 +113,13 @@ def _manual_axes(mesh) -> frozenset:
 
 def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
                    mesh, *, microbatches: int, remat: bool = True,
-                   interleave: int = 1) -> jax.Array:
+                   interleave: int = 1, schedule=None,
+                   has_aux: bool = False):
     """Run ``hidden`` through a layer stack pipelined over ``stage``.
 
     Args:
-        block_fn: pure per-layer function ``(layer_params, x) -> x``.
+        block_fn: pure per-layer function ``(layer_params, x) -> x`` —
+            or ``(layer_params, x) -> (x, aux)`` with ``has_aux=True``.
         stacked_params: pytree whose leaves carry a leading ``layers``
             dimension (e.g. built with ``jax.vmap(block.init)``); ``layers``
             must be divisible by the mesh's ``stage`` size. With
@@ -137,6 +139,28 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
             count pad the last chunk sweep with idle units (the intrinsic
             ring-latency bubble of a short group — see
             :func:`pipeline_train`).
+        schedule: optional :class:`~tpusystem.parallel.schedule
+            .OverlapSchedule`. With ``pp='overlap'`` the loop takes the
+            *skewed double-buffered* tick: each stage issues the
+            ``ppermute`` of last tick's output at tick top — under this
+            tick's stage compute, which consumes the message received a
+            tick earlier — so every stage-to-stage transfer rides under a
+            microbatch's matmuls instead of sitting on the tick-to-tick
+            critical path (the classic tick sends *after* the compute
+            that produced the message, so the next tick's compute waits
+            out the wire). Price: one extra fill tick per stage
+            (``M + 2(S-1)`` ticks vs ``M + S - 1``). The hop is the
+            :func:`~tpusystem.parallel.collectives.pp_hop` custom_vjp,
+            so autodiff's reversed sends hide under the backward matmuls
+            the same way; both schedules compute identical math on
+            identical operands — outputs are **bitwise-equal**. The pure
+            :func:`~tpusystem.parallel.schedule.pp_plan` pins the
+            classic fallback (microbatch rows won't split into
+            ``schedule.chunks`` ppermutes, or ``interleave > 1``).
+        has_aux: ``block_fn`` returns ``(x, aux_scalar)`` per unit (the
+            MoE router losses); the call returns ``(hidden, aux)`` with
+            ``aux`` the mean over every (unit, microbatch) — summed over
+            stages, averaged over batch shards.
     """
     stages = mesh.shape[STAGE]
     chunks = interleave
@@ -162,7 +186,18 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
     padded = (microbatches if chunks == 1
               else -(-microbatches // stages) * stages)
 
-    stage_body = _stage_scan(block_fn)
+    # the pp= arm: the pure plan decides skewed-overlap vs classic ticks
+    # from the per-device microbatch's leading dimension (what pp_hop's
+    # chunked ppermute splits)
+    from tpusystem.parallel.schedule import PpPlan, pp_plan
+    micro_rows = hidden.shape[0] // data_parallel // microbatches
+    if schedule is not None and schedule.pp == 'overlap':
+        plan = pp_plan(micro_rows, stages, chunks=schedule.chunks,
+                       interleave=interleave)
+    else:
+        plan = PpPlan('skip', 1, 'pp overlap inactive')
+
+    stage_body = _stage_scan(block_fn, has_aux=has_aux)
     if remat:
         stage_body = jax.checkpoint(stage_body)
     run_unit = _unit_runner(mesh)
@@ -170,7 +205,8 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(param_specs, activation_spec),
-        out_specs=activation_spec, check_vma=False,
+        out_specs=(activation_spec, P()) if has_aux else activation_spec,
+        check_vma=False,
         axis_names=_manual_axes(mesh))
     def pipelined(params, local_hidden):
         stage = lax.axis_index(STAGE)
@@ -183,7 +219,14 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
             params_all = params
         span = chunks * count
 
-        def schedule(unit):
+        def unit_out(params_c, x):
+            out = stage_body(params_c, x)
+            return out if has_aux else (out, jnp.float32(0))
+
+        def idle_out(x):
+            return jnp.zeros_like(x), jnp.float32(0)
+
+        def schedule_unit(unit):
             """Unit index -> (active, chunk, microbatch) — the forward slot
             of pipeline_train's interleaved schedule; for chunks == 1 it
             reduces to (0 <= unit < M, 0, unit)."""
@@ -195,8 +238,8 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
             return (active, jnp.clip(chunk, 0, chunks - 1),
                     jnp.clip(m, 0, microbatches - 1))
 
-        def tick(state, t):
-            active, c_f, m_f = schedule(t - stage)
+        def classic_tick(state, t):
+            active, c_f, m_f = schedule_unit(t - stage)
             feed = lax.dynamic_index_in_dim(batches, m_f, keepdims=False)
             # a microbatch enters the pipe at stage 0 chunk 0; every later
             # virtual stage consumes the ring message
@@ -208,29 +251,78 @@ def pipeline_apply(block_fn: BlockFn, stacked_params: Any, hidden: jax.Array,
             # idle (fill/drain/pad) ticks skip the block compute (cond —
             # real per-device control flow inside shard_map) or run it
             # masked under PP x TP: see _unit_runner
-            emitted = run_unit(active,
-                               lambda: stage_body(params_c, x),
-                               lambda: jnp.zeros_like(x))
+            emitted, unit_aux = run_unit(active,
+                                         lambda: unit_out(params_c, x),
+                                         lambda: idle_out(x))
             if count > 1:
                 permutation = [(source, (source + 1) % count)
                                for source in range(count)]
                 state = lax.ppermute(emitted, STAGE, permutation)
             else:
                 state = emitted
-            return state, emitted
+            return state, (emitted, unit_aux)
 
-        ticks = chunks * padded + count - 1
-        state = jnp.zeros_like(batches[0])
-        _, emitted = lax.scan(tick, state, jnp.arange(ticks))
-        # the last stage emits microbatch m (final chunk) at tick
-        # (m//S)*v*S + (v-1)*S + m%S + S-1 — contiguous [S-1, S-1+M) for
-        # v == 1; gather the group-strided ticks otherwise
-        emit_ticks = np.array(
-            [(m // stages) * span + (chunks - 1) * stages + (m % stages)
-             + stages - 1 for m in range(microbatches)])
+        if plan.path == 'overlap':
+            # the skewed double-buffered schedule (pp='overlap', plain
+            # GPipe only: chunks == 1 pinned by pp_plan). Stage s computes
+            # microbatch m at tick m + 2s: the carry holds (last tick's
+            # unsent output, the message received last tick), and the
+            # send issues FIRST — this tick's compute consumes `arrived`,
+            # not `incoming`, so the pp_hop transfer and the stage matmuls
+            # are independent within one scan iteration and XLA's
+            # latency-hiding scheduler runs them concurrently. The classic
+            # tick's send sits between its producer and next tick's
+            # consumer — unhideable inside a sequential scan.
+            from tpusystem.parallel.collectives import pp_hop
+            params_0 = jax.tree.map(lambda leaf: leaf[0], params_all)
+
+            def overlap_tick(carry, t):
+                pending, arrived = carry
+                incoming = pp_hop(STAGE, plan.chunks, pending)
+                unit = t - 2 * stage
+                active = (unit >= 0) & (unit < microbatches)
+                m = jnp.clip(unit, 0, microbatches - 1)
+                feed = lax.dynamic_index_in_dim(batches, m, keepdims=False)
+                x = jnp.where(stage == 0, feed, arrived)
+                emitted, unit_aux = run_unit(active,
+                                             lambda: unit_out(params_0, x),
+                                             lambda: idle_out(x))
+                # aux rides the scan ys, not the carry: a scalar carried
+                # across the scan becomes a scalar shard_map residual at
+                # linearization, which this jax's partial-eval cannot
+                # name-check ({0: all_names} on a rank-0 aval)
+                return (emitted, incoming), (emitted, unit_aux)
+
+            ticks = microbatches + 2 * (count - 1)
+            zero = jnp.zeros_like(batches[0])
+            _, (emitted, aux_ticks) = lax.scan(overlap_tick, (zero, zero),
+                                               jnp.arange(ticks))
+            aux_local = jnp.sum(aux_ticks)
+            # the last stage computes microbatch m at tick m + 2(S-1)
+            emit_ticks = np.arange(microbatches) + 2 * (count - 1)
+        else:
+            ticks = chunks * padded + count - 1
+            state = jnp.zeros_like(batches[0])
+            _, (emitted, aux_ticks) = lax.scan(classic_tick, state,
+                                               jnp.arange(ticks))
+            aux_local = jnp.sum(aux_ticks)
+            # the last stage emits microbatch m (final chunk) at tick
+            # (m//S)*v*S + (v-1)*S + m%S + S-1 — contiguous [S-1, S-1+M)
+            # for v == 1; gather the group-strided ticks otherwise
+            emit_ticks = np.array(
+                [(m // stages) * span + (chunks - 1) * stages + (m % stages)
+                 + stages - 1 for m in range(microbatches)])
         outputs = jnp.take(emitted, emit_ticks, axis=0)
         outputs = _broadcast_from_last(outputs, stage, count)
-        return outputs.reshape(local_hidden.shape)
+        outputs = outputs.reshape(local_hidden.shape)
+        if not has_aux:
+            return outputs
+        # aux: sum over stages (each unit lives on exactly one stage),
+        # mean over every (unit, microbatch), mean over batch shards
+        aux = lax.psum(aux_local, STAGE) / (microbatches * layers)
+        if batch_axes:
+            aux = lax.pmean(aux, batch_axes)
+        return outputs, aux
 
     if _needs_jit_wrap(mesh):
         pipelined = jax.jit(pipelined)
@@ -256,8 +348,23 @@ def _broadcast_from_last(outputs, stage, count: int):
     return state
 
 
-def _stage_scan(block_fn: BlockFn):
-    """Apply this stage's local layer stack (leading dim layers/stages)."""
+def _stage_scan(block_fn: BlockFn, has_aux: bool = False):
+    """Apply this stage's local layer stack (leading dim layers/stages).
+
+    With ``has_aux`` the block_fn returns ``(x, aux_scalar)`` per unit
+    (MoE router losses) and the stage body returns ``(x, aux_sum)`` —
+    the f32 sum over this stage's local units, reduced across stages and
+    microbatches by the caller."""
+    if has_aux:
+        def run_aux(params, state):
+            def layer(carry, layer_params):
+                x, aux = carry
+                x, unit_aux = block_fn(layer_params, x)
+                return (x, aux + unit_aux.astype(jnp.float32)), None
+            carry, _ = lax.scan(layer, (state, jnp.float32(0)), params)
+            return carry
+        return run_aux
+
     def run(params, state):
         def layer(carry, layer_params):
             return block_fn(layer_params, carry), None

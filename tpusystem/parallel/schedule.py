@@ -49,9 +49,36 @@ the schedule and the policy (both default 4096) or jit inserts a
 reshard at the manual boundary — correct, but the transfer lands back on
 the critical path.
 
+Two further collective families joined the schedule as arms of the same
+strategy object (they are implemented where the collectives live, and
+planned here):
+
+* **Pipeline p2p** (``pp='overlap'``): the GPipe loop
+  (:func:`tpusystem.parallel.pipeline.pipeline_apply`) issues the next
+  microbatch's activation ``ppermute`` send *under* the current
+  microbatch's stage compute — the skewed double-buffered tick (each
+  stage sends last tick's output while computing this tick's microbatch,
+  the PR-2/PR-6 ring idiom: transfer launched before the compute that
+  hides it), with a ``custom_vjp`` hop
+  (:func:`tpusystem.parallel.collectives.pp_hop`) so the backward's
+  reversed sends hide under the backward matmuls the same way. The pure
+  :func:`pp_plan` pins the one-shot fallback (classic post-compute
+  sends) when the microbatch rows won't split into ``chunks`` ppermutes
+  or the interleaved schedule owns the ticks.
+* **MoE expert all-to-all** (``moe='overlap'``): the quota'd sharded
+  sparse dispatch (:class:`tpusystem.ops.moe.MoEMLP`) splits its local
+  token rows into microbatch pieces and issues piece ``k+1``'s dispatch
+  ``all_to_all`` under the expert matmuls of piece ``k`` (the return
+  exchange of ``k`` rides under the matmuls of ``k+1``). The pure
+  :func:`moe_plan` pins the one-shot fallback (the single whole-batch
+  exchange) for the ragged exchanges (receiver-seated, not yet
+  pipelined) and for row counts that won't split.
+
 Model wiring: GPT-2 and Llama accept ``schedule=OverlapSchedule(...)``
 (threaded through ``Block``/``BlockSpan`` and the Llama twins, scan and
-unrolled paths); :func:`resolve_schedule` folds the legacy
+unrolled paths; ``GPT2Pipelined`` threads ``pp=`` into the GPipe loop
+and ``moe=`` reaches :class:`~tpusystem.ops.moe.MoEMLP` through the
+block plumbing); :func:`resolve_schedule` folds the legacy
 ``tp_impl=``/``tp_chunks=`` pair into the same object so existing
 configs keep working. Param trees are built from the same
 ``DenseParams`` holders either way — the knob never changes a
@@ -99,6 +126,19 @@ class OverlapSchedule:
         fsdp_min_size: leaves with fewer elements are expected unsharded
             (must match the placement policy's ``fsdp_min_size``; the
             plans consult it so a tiny bias is never gathered).
+        pp: ``'gspmd'`` keeps the classic GPipe tick (stage-to-stage
+            ``ppermute`` after the compute that produced it, on the
+            critical path between ticks); ``'overlap'`` skews the GPipe
+            loop so every send is issued *under* the next microbatch's
+            stage compute (:func:`~tpusystem.parallel.pipeline
+            .pipeline_apply`; backward's reversed sends hide under the
+            backward matmuls via the ``pp_hop`` custom_vjp).
+        moe: ``'gspmd'`` keeps the one-shot expert exchange (the whole
+            local batch's ``all_to_all`` before any expert matmul);
+            ``'overlap'`` splits the quota'd sharded dispatch into
+            microbatch pieces and issues piece ``k+1``'s dispatch under
+            the expert matmuls of piece ``k``
+            (:class:`tpusystem.ops.moe.MoEMLP`).
 
     A registered entity: its knobs capture into the experiment identity
     hash (like :class:`~tpusystem.parallel.mesh.MeshSpec`), so runs under
@@ -107,22 +147,32 @@ class OverlapSchedule:
     """
 
     def __init__(self, tp: str = 'gspmd', fsdp: str = 'gspmd',
-                 chunks: int = 1, fsdp_min_size: int = 4096):
+                 chunks: int = 1, fsdp_min_size: int = 4096,
+                 pp: str = 'gspmd', moe: str = 'gspmd'):
         if tp not in ('gspmd', 'overlap'):
             raise ValueError(f'unknown schedule tp {tp!r}; '
                              "expected 'gspmd' or 'overlap'")
         if fsdp not in ('gspmd', 'prefetch'):
             raise ValueError(f'unknown schedule fsdp {fsdp!r}; '
                              "expected 'gspmd' or 'prefetch'")
+        if pp not in ('gspmd', 'overlap'):
+            raise ValueError(f'unknown schedule pp {pp!r}; '
+                             "expected 'gspmd' or 'overlap'")
+        if moe not in ('gspmd', 'overlap'):
+            raise ValueError(f'unknown schedule moe {moe!r}; '
+                             "expected 'gspmd' or 'overlap'")
         if chunks < 1:
             raise ValueError(f'chunks must be >= 1, got {chunks}')
         self.tp = tp
         self.fsdp = fsdp
         self.chunks = chunks
         self.fsdp_min_size = fsdp_min_size
+        self.pp = pp
+        self.moe = moe
 
     def _key(self):
-        return (self.tp, self.fsdp, self.chunks, self.fsdp_min_size)
+        return (self.tp, self.fsdp, self.chunks, self.fsdp_min_size,
+                self.pp, self.moe)
 
     def __eq__(self, other):
         return (isinstance(other, OverlapSchedule)
@@ -133,18 +183,20 @@ class OverlapSchedule:
 
     def __repr__(self):
         return (f'OverlapSchedule(tp={self.tp!r}, fsdp={self.fsdp!r}, '
-                f'chunks={self.chunks}, fsdp_min_size={self.fsdp_min_size})')
+                f'chunks={self.chunks}, fsdp_min_size={self.fsdp_min_size}, '
+                f'pp={self.pp!r}, moe={self.moe!r})')
 
     @classmethod
     def for_policy(cls, policy, *, tp: str = 'gspmd',
-                   fsdp: str = 'prefetch', chunks: int = 1):
+                   fsdp: str = 'prefetch', chunks: int = 1,
+                   pp: str = 'gspmd', moe: str = 'gspmd'):
         """Schedule paired to a placement policy: ``fsdp_min_size`` is
         copied from the :class:`~tpusystem.parallel.sharding.ShardingPolicy`
         so the manual in_specs replicate its placement exactly. A
         mismatched pair is still correct, but jit inserts a reshard at
         the manual boundary — the transfer this schedule exists to hide."""
         return cls(tp=tp, fsdp=fsdp, chunks=chunks,
-                   fsdp_min_size=policy.fsdp_min_size)
+                   fsdp_min_size=policy.fsdp_min_size, pp=pp, moe=moe)
 
 
 def resolve_schedule(schedule, tp_impl: str = 'gspmd',
@@ -229,6 +281,92 @@ def fsdp_plan(shape: tuple[int, ...], ring: int, *, taken=(),
 
 
 _SKIP = FsdpPlan('skip', -1, 1, 'fsdp prefetch inactive')
+
+
+class PpPlan(NamedTuple):
+    """Which tick schedule the GPipe pipeline takes.
+
+    ``path`` is ``'overlap'`` (the skewed double-buffered schedule: every
+    stage-to-stage send issued under the next microbatch's compute),
+    ``'one-shot'`` (the classic tick — send after the compute that
+    produced it; the requested ``chunks`` cannot tile the microbatch
+    rows, or the interleaved schedule owns the ticks), or ``'skip'``
+    (``stage`` axis of size 1: there are no sends to hide). ``chunks``
+    is the per-hop ppermute payload split the overlap hop will use,
+    ``reason`` documents a fallback.
+    """
+
+    path: str
+    chunks: int
+    reason: str
+
+
+def pp_plan(rows: int, stages: int, chunks: int = 1,
+            interleave: int = 1) -> PpPlan:
+    """Plan the pipeline's stage-to-stage sends — pure, so tests can pin
+    the path.
+
+    ``rows`` is the per-device microbatch's leading (batch) dimension —
+    what :func:`~tpusystem.parallel.collectives.pp_hop` splits into
+    ``chunks`` independent ``ppermute``\\ s. The skewed schedule pays one
+    extra fill tick per stage (``M + 2(S-1)`` ticks vs ``M + S - 1``) to
+    take every transfer off the tick-to-tick critical path — second-order
+    at realistic ``M >= 4S``, which is why the fallback is the classic
+    schedule, not a crash. The interleaved (``v > 1``) GPipe forward
+    keeps its own tick formulas and stays classic.
+    """
+    if stages == 1:
+        return PpPlan('skip', 1, 'axis_size == 1')
+    if interleave > 1:
+        return PpPlan('one-shot', 1,
+                      'interleaved schedule keeps the classic ticks')
+    if chunks < 1 or rows % chunks:
+        return PpPlan('one-shot', 1,
+                      f'microbatch rows ({rows}) not divisible by chunks '
+                      f'({chunks})')
+    return PpPlan('overlap', chunks, '')
+
+
+class MoePlan(NamedTuple):
+    """Which dispatch schedule the sharded sparse MoE takes.
+
+    ``path`` is ``'overlap'`` (local rows split into ``pieces``
+    microbatch pieces, piece ``k+1``'s dispatch ``all_to_all`` issued
+    under the expert matmuls of piece ``k``), ``'one-shot'`` (the single
+    whole-batch exchange — the ragged exchanges, or rows that won't
+    split), or ``'skip'`` (expert axis of size 1: no exchange exists).
+    ``reason`` documents a fallback.
+    """
+
+    path: str
+    pieces: int
+    reason: str
+
+
+def moe_plan(local_rows: int, expert_size: int, exchange: str = 'quota',
+             pieces: int = 2) -> MoePlan:
+    """Plan the expert-parallel dispatch pipeline — pure, so tests can
+    pin the path.
+
+    Only the quota'd regular-``all_to_all`` formulation pipelines today:
+    the ragged exchanges seat capacity at the *receiver* from gathered
+    count matrices, so their geometry is a cross-piece dependency the
+    pipeline would have to exchange twice. Rows must split evenly into
+    ``pieces`` (each piece routes and seats independently — per-piece
+    quotas are the quota path's per-sender drop discipline at finer
+    grain; with ample capacity all formulations agree exactly).
+    """
+    if expert_size == 1:
+        return MoePlan('skip', 1, 'axis_size == 1')
+    if exchange != 'quota':
+        return MoePlan('one-shot', 1,
+                       f'{exchange!r} exchange seats at the receiver; '
+                       'pipelined dispatch is quota-only')
+    if pieces < 2 or local_rows % pieces or local_rows < 2 * pieces:
+        return MoePlan('one-shot', 1,
+                       f'local rows ({local_rows}) will not split into '
+                       f'{pieces} pieces')
+    return MoePlan('overlap', pieces, '')
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))

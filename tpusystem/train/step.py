@@ -310,6 +310,11 @@ def build_1f1b_train_step(model, criterion: Criterion, optimizer,
     """
     from tpusystem.parallel.pipeline import pipeline_train
 
+    if getattr(model, 'moe_experts', 0):
+        raise ValueError(
+            'build_1f1b_train_step does not support MoE spans (the router '
+            'aux channel rides the GPipe path only) — use build_train_step')
+
     transform = optimizer.transform() if hasattr(optimizer, 'transform') else optimizer
 
     def tail_fn(replicated, activations, micro_targets):
