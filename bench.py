@@ -24,6 +24,9 @@ config plus the per-step streamed weight bytes auto-vs-int8 — the
 roofline lever, ``benchmarks/decode_roofline.py``), then the
 ``serve_tok_s`` row (continuous batching vs static padded batching
 through the serving engine, ``benchmarks/serve_bench.py headline``),
+then the ``serve_recovery_seconds`` row (kill -> first replayed token
+through the serving failover layer, hot journal replay vs cold
+re-submit, ``benchmarks/serve_recovery.py headline``),
 then the ``embedding_lookup_speedup`` row (the recommender workload's
 fused Pallas lookup vs the ``jnp.take`` fallback,
 ``benchmarks/embedding_bench.py headline``),
@@ -164,6 +167,16 @@ def serve_row() -> None:
     BASELINE.md "serve protocol" — CPU numbers are smoke, the >= 2x
     speedup ratio is the architectural claim)."""
     _overlap_probe_row('serve_bench.py', 'serve_tok_s')
+
+
+def serve_recovery_row() -> None:
+    """The serving-failover recovery row: wall seconds from a mid-decode
+    kill to the first replayed token, hot journal replay vs cold
+    re-submit (`benchmarks/serve_recovery.py headline`; the journal +
+    token-prefix replay of `tpusystem/serve/failover.py` — both arms
+    finish token-exact, the hot arm skips re-decoding already-delivered
+    tokens)."""
+    _overlap_probe_row('serve_recovery.py', 'serve_recovery_seconds')
 
 
 BATCH, SEQ = 16, 1024
@@ -438,5 +451,6 @@ if __name__ == '__main__':
     resize_seconds_row()
     decode_rows()
     serve_row()
+    serve_recovery_row()
     embedding_row()
     main()

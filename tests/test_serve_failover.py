@@ -1,0 +1,846 @@
+"""Serving failover: journaled requests, token-prefix replay, supervised
+engine relaunch (tpusystem/serve/failover.py).
+
+The contract under drill: a serving replica killed (SIGKILL /
+kill-at-tick-k), hung (stalled-step watchdog), or overloaded (watermark
+shedding) survives without corrupting a single completion — greedy
+decode is deterministic, so a replayed request's final output is
+TOKEN-EXACT against an uninterrupted reference, whether it replays hot
+from its journaled prefix or cold from scratch. The journal is digest-
+verified at every hop (a corrupt copy reads as absent, falls to the
+buddy replica, then to cold re-submit — never to wrong tokens), and all
+of it runs on injectable clocks with zero real sleeps in tier-1.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusystem.checkpoint.memstore import MemStore, blob_digest
+from tpusystem.models import gpt2_tiny
+from tpusystem.parallel.chaos import DieAtStep, StalledStep, WorkerKilled
+from tpusystem.serve import (Engine, EngineStalled, InferenceService,
+                             JournalCorrupt, QueueFull, Request,
+                             RequestJournal, Scheduler, ServingReplica,
+                             StepWatchdog, Watermarks, journal_identity,
+                             recover_journal, replay)
+from tpusystem.train import generate
+
+
+class FakeClock:
+    """Injectable monotonic clock — the Supervisor test discipline."""
+
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture(scope='module')
+def served():
+    module = gpt2_tiny(dtype='float32')
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (1, 8)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    return module, params
+
+
+def reference(module, params, prompt, steps):
+    out = generate(module, params, jnp.asarray(prompt, jnp.int32)[None],
+                   steps=steps)
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+def build_for(module, params, **kwargs):
+    knobs = dict(rows=2, block_size=8)
+    knobs.update(kwargs)
+    engine_knobs = {k: knobs.pop(k) for k in ('rows', 'block_size', 'blocks')
+                    if k in knobs}
+    return lambda: Scheduler(Engine(module, params, **engine_knobs), **knobs)
+
+
+def workload(seed=5, lengths=(5, 9, 7), budgets=(12, 10, 8)):
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, 256, (n,)).tolist() for n in lengths]
+    return prompts, list(budgets)
+
+
+# ---------------------------------------------------------------------------
+# the journal: pack/unpack, digest, lifecycle, replication cadence
+# ---------------------------------------------------------------------------
+
+
+class TestJournal:
+
+    def test_pack_unpack_round_trip_with_digest(self):
+        clock = FakeClock()
+        journal = RequestJournal('j', clock=clock)
+        journal.record(Request('a', [1, 2, 3], 8), clock())
+        clock.advance(2.0)
+        journal.seated('a', 7)
+        journal.append('a', 9)
+        journal.record(Request('b', [4], 4, deadline=30.0), clock())
+        journal.tick = 11
+        tick, rows = RequestJournal.unpack(journal.pack())
+        assert tick == 11
+        assert [(r.id, waited, emitted)
+                for r, waited, emitted in rows] == [
+                    ('a', 2.0, [7, 9]), ('b', 0.0, [])]
+        assert rows[1][0].deadline == 30.0
+
+    @pytest.mark.parametrize('mangle', [
+        lambda data: data[:len(data) // 2],                  # truncated
+        lambda data: data[:-3] + b'???',                     # flipped tail
+        lambda data: b'deadbeef' + data,                     # bad digest
+    ])
+    def test_corrupt_bytes_raise_journal_corrupt(self, mangle):
+        journal = RequestJournal('j')
+        journal.record(Request('a', [1, 2], 4), 0.0)
+        with pytest.raises(JournalCorrupt):
+            RequestJournal.unpack(mangle(journal.pack()))
+
+    def test_lifecycle_leaves_no_rows(self, served):
+        """Every terminal transition (length completion, queued cancel,
+        active cancel) removes the row — a drained replica's journal is
+        empty, so a relaunch replays nothing."""
+        module, params = served
+        prompts, budgets = workload()
+        scheduler = build_for(module, params)()
+        scheduler.journal = journal = RequestJournal('j')
+        for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            scheduler.submit(Request(f'r{index}', prompt, budget))
+        assert set(journal.rows) == {'r0', 'r1', 'r2'}
+        scheduler.step()
+        assert len(journal.rows['r0'].emitted) >= 1   # seated: admission
+        assert not journal.rows['r2'].emitted         # still queued
+        scheduler.cancel('r2')                      # queued cancel
+        assert 'r2' not in journal.rows
+        scheduler.cancel('r1')                      # active cancel
+        assert 'r1' not in journal.rows
+        scheduler.run()
+        assert journal.rows == {}
+
+    def test_cadence_and_monotonic_tick(self):
+        store = MemStore()
+        journal = RequestJournal('cad', client=store, cadence=3)
+        for _ in range(7):
+            journal.observe_tick()
+        assert journal.pushes == 2                  # ticks 3 and 6
+        entry = store.fetch(journal_identity('cad'))
+        assert entry.step == 6
+        # a relaunch seeds the tick from the recovered journal, so the
+        # store's monotonic slot discipline keeps accepting pushes
+        tick, _ = RequestJournal.unpack(entry.blob)
+        relaunched = RequestJournal('cad', client=store, cadence=3)
+        relaunched.tick = tick
+        for _ in range(3):
+            relaunched.observe_tick()
+        assert store.fetch(journal_identity('cad')).step == 9
+
+    def test_push_failure_degrades_and_logs_once(self, caplog):
+        class DeadClient:
+            def push(self, *args, **kwargs):
+                raise OSError('supervisor gone')
+
+            def fetch(self, identity):
+                return None
+
+        journal = RequestJournal('dead', client=DeadClient(), cadence=1)
+        with caplog.at_level(logging.WARNING, 'tpusystem.serve.failover'):
+            journal.observe_tick()
+            journal.observe_tick()
+        assert not journal.pushes
+        assert caplog.text.count('journal replication') == 1
+
+    def test_recover_journal_skips_corrupt_and_missing(self, caplog):
+        good = MemStore()
+        journal = RequestJournal('rec', client=good, cadence=1)
+        journal.record(Request('a', [1, 2], 4), 0.0)
+        journal.observe_tick()
+        corrupt = MemStore()
+        corrupt.put(journal_identity('rec'), 5, b'garbage-bytes')
+        with caplog.at_level(logging.WARNING, 'tpusystem.serve.failover'):
+            recovered = recover_journal('rec', (None, MemStore(), corrupt,
+                                                good))
+        assert recovered is not None
+        tick, rows = recovered
+        assert tick == 1 and rows[0][0].id == 'a'
+        assert 'rejected' in caplog.text
+        assert recover_journal('rec', (MemStore(),)) is None
+
+
+# ---------------------------------------------------------------------------
+# the chaos drill: kill at tick k -> relaunch -> replay -> token-exact
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_kill_at_tick_k_replay_is_token_exact(served):
+    """The headline: journal pushed every tick; the replica dies at tick
+    4 mid-decode (objects abandoned, journal lives in the supervisor-side
+    store); a fresh replica recovers, replays seated rows hot from their
+    emitted prefixes and the queued row cold, and EVERY completion is
+    token-exact vs the uninterrupted reference."""
+    module, params = served
+    prompts, budgets = workload()
+    refs = [reference(module, params, p, b)
+            for p, b in zip(prompts, budgets)]
+    store = MemStore()
+    build = build_for(module, params)
+    replica = ServingReplica(build, identity='drill', client=store,
+                             cadence=1)
+    assert not replica.recovered
+    for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+        replica.submit(Request(f'r{index}', prompt, budget))
+    for _ in range(4):
+        replica.step()
+    # SIGKILL stand-in: nothing flushed, nothing drained — only the
+    # journal already replicated out of "the process" survives
+    relaunched = ServingReplica(build, identity='drill', client=store,
+                                cadence=1)
+    assert relaunched.recovered
+    assert set(relaunched.report.replayed) == {'r0', 'r1'}
+    assert relaunched.report.resubmitted == ['r2']
+    results = relaunched.run_until_idle()
+    for index in range(3):
+        got = results[f'r{index}']
+        assert got.tokens == refs[index], f'r{index} diverged after replay'
+        assert got.reason == 'length'
+    assert relaunched.scheduler.engine.trace_count == 1
+
+
+@pytest.mark.slow
+def test_kill_via_chaos_fault_mid_step(served):
+    """The same drill through the chaos seam: DieAtStep fires at tick 3
+    (the in-process WorkerKilled form); the journal already holds tick
+    2's deltas, so the relaunch replays and finishes token-exact."""
+    module, params = served
+    prompts, budgets = workload(seed=11, lengths=(6, 4), budgets=(9, 7))
+    refs = [reference(module, params, p, b)
+            for p, b in zip(prompts, budgets)]
+    store = MemStore()
+    build = build_for(module, params)
+    replica = ServingReplica(build, identity='chaos', client=store,
+                             cadence=1, fault=DieAtStep(step=3))
+    for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+        replica.submit(Request(f'r{index}', prompt, budget))
+    with pytest.raises(WorkerKilled):
+        replica.run_until_idle()
+    relaunched = ServingReplica(build, identity='chaos', client=store,
+                                cadence=1)
+    assert relaunched.recovered
+    results = relaunched.run_until_idle()
+    for index in range(2):
+        assert results[f'r{index}'].tokens == refs[index]
+
+
+class _Replicating:
+    """The supervisor's buddy-replication discipline, in miniature: every
+    verified local push mirrors to the buddy's replica namespace."""
+
+    def __init__(self, local, buddy):
+        self.local, self.buddy = local, buddy
+
+    def push(self, identity, step, blob, extras=None):
+        self.local.put(identity, step, blob, extras=extras)
+        self.buddy.put(identity, step, blob, extras=extras, replica=True)
+        return True
+
+    def fetch(self, identity):
+        return self.local.fetch(identity)
+
+
+class _ReplicaView:
+    """Read a buddy store's replica namespace — the serving side of the
+    replaced-host pull (`hot:{identity}` answers from replica slots)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def fetch(self, identity):
+        return self.store.newest(identity, replica=True)
+
+
+@pytest.mark.slow
+def test_corrupt_local_journal_recovers_from_buddy(served, caplog):
+    """Acceptance: the local journal slot is corrupted in RAM after the
+    kill — the digest check reads it as ABSENT (never as requests) and
+    recovery falls through to the buddy's replica copy; completions stay
+    token-exact."""
+    module, params = served
+    prompts, budgets = workload(seed=13, lengths=(5, 7), budgets=(10, 6))
+    refs = [reference(module, params, p, b)
+            for p, b in zip(prompts, budgets)]
+    local, buddy = MemStore(), MemStore()
+    build = build_for(module, params)
+    replica = ServingReplica(build, identity='pair',
+                             client=_Replicating(local, buddy), cadence=1)
+    for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+        replica.submit(Request(f'r{index}', prompt, budget))
+    for _ in range(3):
+        replica.step()
+    # the kill, then RAM corruption of the local slot: flip payload bytes
+    entry = local.fetch(journal_identity('pair'))
+    entry.blob = entry.blob[:-4] + b'!!!!'
+    with caplog.at_level(logging.WARNING):
+        relaunched = ServingReplica(
+            build, identity='pair', client=local,
+            fallbacks=(_ReplicaView(buddy),), cadence=1)
+    assert 'digest' in caplog.text            # the corrupt slot was seen
+    assert relaunched.recovered               # ... and the buddy answered
+    results = relaunched.run_until_idle()
+    for index in range(2):
+        assert results[f'r{index}'].tokens == refs[index]
+
+
+class _TornPushes:
+    """MemStoreClient semantics under a torn wire: the sender digests the
+    FULL payload, the receiving store rejects the truncated bytes and
+    keeps its previous verified copy (push returns False)."""
+
+    def __init__(self, store, good: int):
+        self.store, self.good, self.count = store, good, 0
+
+    def push(self, identity, step, blob, extras=None):
+        self.count += 1
+        digest = blob_digest(bytes(blob))
+        if self.count > self.good:
+            blob = blob[:len(blob) // 2]
+        try:
+            self.store.put(identity, step, blob, digest=digest)
+            return True
+        except ValueError:
+            return False
+
+    def fetch(self, identity):
+        return self.store.fetch(identity)
+
+
+@pytest.mark.slow
+def test_truncated_replication_degrades_to_cold_resubmit(served, caplog):
+    """Acceptance: replication is torn from tick 3 on, so the store's
+    newest verified journal is OLDER than the kill point. Recovery
+    replays the seated row hot from its shorter prefix (more re-decode,
+    same tokens) and the row that journal only knew as queued re-submits
+    cold — no crash, every completion token-exact."""
+    module, params = served
+    prompts, budgets = workload(seed=17, lengths=(6, 5), budgets=(12, 5))
+    refs = [reference(module, params, p, b)
+            for p, b in zip(prompts, budgets)]
+    store = MemStore()
+    build = build_for(module, params, rows=1)     # r1 must queue
+    replica = ServingReplica(build, identity='torn',
+                             client=_TornPushes(store, good=2), cadence=1)
+    for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+        replica.submit(Request(f'r{index}', prompt, budget))
+    with caplog.at_level(logging.WARNING, 'tpusystem.serve.failover'):
+        for _ in range(6):                        # kill at tick 6
+            replica.step()
+    assert 'journal replication' in caplog.text   # degraded, not crashed
+    held = store.fetch(journal_identity('torn'))
+    assert held.step == 2                         # old verified copy stands
+    relaunched = ServingReplica(build, identity='torn', client=store,
+                                cadence=1)
+    assert relaunched.recovered
+    assert relaunched.report.replayed == ['r0']
+    assert relaunched.report.resubmitted == ['r1']
+    # the tick-2 prefix (admission token + 2 decode tokens) is shorter
+    # than the 7 tokens r0 had emitted by tick 6 — replay just re-decodes
+    # the lost tail, landing on the same greedy tokens
+    assert len(relaunched.scheduler.journal.rows['r0'].emitted) == 3
+    results = relaunched.run_until_idle()
+    for index in range(2):
+        assert results[f'r{index}'].tokens == refs[index]
+
+
+def test_unrecoverable_journal_serves_fresh_traffic(served):
+    """No journal anywhere (or journaling off): the replica starts
+    empty and serves — losing the backlog degrades service, it never
+    crashes it."""
+    module, params = served
+    build = build_for(module, params)
+    replica = ServingReplica(build, identity='fresh', client=MemStore())
+    assert not replica.recovered and replica.report.replayed == []
+    prompts, budgets = workload(seed=19, lengths=(4,), budgets=(5,))
+    replica.submit(Request('only', prompts[0], budgets[0]))
+    results = replica.run_until_idle()
+    assert results['only'].tokens == reference(module, params, prompts[0],
+                                               budgets[0])
+
+
+def test_restore_rejects_finished_rows(served):
+    module, params = served
+    scheduler = build_for(module, params)()
+    with pytest.raises(ValueError, match='no business in the journal'):
+        scheduler.restore(Request('done', [1, 2], 3), prefix=[5, 6, 7])
+
+
+def test_replayed_request_past_deadline_expires_truthfully(served):
+    """A journaled request whose deadline passed before (or during) the
+    outage is NOT silently dropped by replay: it re-queues with its
+    original submission backdated, and the scheduler's ordinary expiry
+    retires it with the typed 'expired' verdict on the next step."""
+    module, params = served
+    clock = FakeClock()
+    scheduler = build_for(module, params, clock=clock)()
+    report = replay(scheduler,
+                    [(Request('late', [1, 2, 3], 6, deadline=5.0), 9.0,
+                      [7, 7])])
+    assert report.replayed == ['late']
+    tick = scheduler.step()
+    assert [(completion.request.id, where)
+            for completion, where in tick.expired] == [('late', 'queued')]
+    late = scheduler.results['late']
+    assert late.reason == 'expired'
+    assert late.tokens == [7, 7]                  # partial output survives
+    assert late.seconds >= 9.0
+
+
+# ---------------------------------------------------------------------------
+# the step watchdog: hung/slow decode becomes a typed verdict + relaunch
+# ---------------------------------------------------------------------------
+
+
+class TestWatchdog:
+
+    def test_absolute_stall_threshold(self):
+        dog = StepWatchdog(stall_after=2.0, slow_factor=None)
+        dog.observe(1.99)
+        with pytest.raises(EngineStalled, match='stall') as caught:
+            dog.observe(2.0)
+        assert caught.value.seconds == 2.0
+
+    def test_ema_slow_verdict_is_warmup_gated_and_unpolluted(self):
+        dog = StepWatchdog(slow_factor=4.0, warmup=3, floor=0.0)
+        dog.observe(8.0)              # would be 'slow' later; warmup passes
+        for _ in range(5):
+            dog.observe(1.0)
+        with pytest.raises(EngineStalled, match='slow'):
+            dog.observe(dog.ema * 4.0 + 0.01)
+        # the anomalous sample did NOT fold into the EMA that caught it
+        healthy = dog.ema
+        dog.observe(1.0)
+        assert dog.ema <= healthy + 1e-9
+
+    def test_unarmed_watchdog_is_refused(self):
+        with pytest.raises(ValueError, match='unarmed'):
+            StepWatchdog(stall_after=None, slow_factor=None)
+
+    def test_deadman_guard_arms_and_cancels(self):
+        fired = []
+
+        class FakeTimer:
+            instances = []
+
+            def __init__(self, interval, function):
+                self.interval, self.function = interval, function
+                self.cancelled = False
+                FakeTimer.instances.append(self)
+
+            def start(self):
+                pass
+
+            def cancel(self):
+                self.cancelled = True
+
+        dog = StepWatchdog(stall_after=1.5, slow_factor=None,
+                           on_stall=lambda: fired.append(True),
+                           timer=FakeTimer)
+        with dog.guard():
+            pass                      # the step returned in time
+        (timer,) = FakeTimer.instances
+        assert timer.interval == 1.5 and timer.cancelled and not fired
+        timer.function()              # what a real expiry would run
+        assert fired == [True]
+        with pytest.raises(ValueError, match='stall_after'):
+            StepWatchdog(slow_factor=2.0).guard()
+
+    @pytest.mark.slow
+    def test_stalled_step_fires_relaunch_and_replay_token_exact(
+            self, served):
+        """Acceptance: a stalled decode step at tick 3 (chaos
+        StalledStep advancing the fake clock 10s) trips the watchdog ->
+        typed EngineStalled -> in-process relaunch -> journal replay;
+        the affected requests' completions are token-exact vs the
+        uninterrupted reference. Zero real sleeps."""
+        module, params = served
+        prompts, budgets = workload(seed=23, lengths=(5, 8), budgets=(9, 6))
+        refs = [reference(module, params, p, b)
+                for p, b in zip(prompts, budgets)]
+        clock = FakeClock()
+        witnessed = []
+        from tpusystem.observe.events import EngineRestarted, RequestReplayed
+        from tpusystem.services.prodcon import Consumer, Producer
+        consumer = Consumer('probe')
+        consumer.register(EngineRestarted, witnessed.append)
+        consumer.register(RequestReplayed, witnessed.append)
+        producer = Producer()
+        producer.register(consumer)
+        replica = ServingReplica(
+            build_for(module, params, clock=clock),
+            identity='stall', client=MemStore(), cadence=1,
+            watchdog=StepWatchdog(stall_after=5.0, slow_factor=None),
+            producer=producer, clock=clock,
+            fault=StalledStep(tick=3, action=lambda: clock.advance(10.0)))
+        for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            replica.submit(Request(f'r{index}', prompt, budget))
+        results = replica.run_until_idle()
+        assert replica.relaunches == 1
+        for index in range(2):
+            assert results[f'r{index}'].tokens == refs[index], (
+                f'r{index} diverged across the stall relaunch')
+        restarts = [e for e in witnessed
+                    if isinstance(e, EngineRestarted)]
+        assert [e.cause for e in restarts] == ['stalled']
+        assert restarts[0].replayed == 2
+        replayed = {e.id: e for e in witnessed
+                    if isinstance(e, RequestReplayed)}
+        assert set(replayed) == {'r0', 'r1'}
+        assert all(e.where == 'hot' and e.prefix > 0
+                   for e in replayed.values())
+
+
+def test_replica_deadman_arms_each_watched_tick(served):
+    """deadman=True wraps every watched tick in StepWatchdog.guard (the
+    defense for a step that NEVER returns — post-hoc observe can't see
+    it): one timer armed and cancelled per tick, with the first tick
+    after the build exempt (a decode compile must not read as a hang).
+    Opt-in, because the default expiry action exits the process."""
+    module, params = served
+
+    class FakeTimer:
+        instances = []
+
+        def __init__(self, interval, function):
+            self.interval, self.function = interval, function
+            self.cancelled = False
+            FakeTimer.instances.append(self)
+
+        def start(self):
+            pass
+
+        def cancel(self):
+            self.cancelled = True
+
+    replica = ServingReplica(
+        build_for(module, params, rows=1), identity='deadman',
+        watchdog=StepWatchdog(stall_after=30.0, slow_factor=None,
+                              timer=FakeTimer),
+        deadman=True)
+    replica.submit(Request('only', [1, 2, 3, 4], 3))
+    replica.run_until_idle()
+    ticks = replica.scheduler.steps
+    assert len(FakeTimer.instances) == ticks - 1    # build tick exempt
+    assert all(timer.cancelled and timer.interval == 30.0
+               for timer in FakeTimer.instances)
+    with pytest.raises(ValueError, match='deadman'):
+        ServingReplica(build_for(module, params), deadman=True)
+
+
+def test_replica_refuses_a_mismatched_scheduler_clock(served):
+    """The journal subtracts scheduler timestamps from the replica
+    clock; a build() that forgets to thread the same clock through
+    Scheduler(clock=) would backdate every replay by garbage — refused
+    at construction, not discovered as corrupt deadlines after a
+    relaunch."""
+    module, params = served
+    clock = FakeClock()
+    with pytest.raises(ValueError, match='share one clock'):
+        ServingReplica(build_for(module, params), clock=clock)
+    with pytest.raises(ValueError, match='share one clock'):
+        ServingReplica(build_for(module, params, clock=clock))
+
+
+def test_clientless_relaunch_replays_from_the_live_journal(served):
+    """Review regression: a replica journaling only in RAM (no client —
+    the constructor default) must not lose its queued and in-flight
+    requests to a watchdog relaunch. In-process, the live journal is
+    strictly fresher than any replicated copy and replays directly."""
+    module, params = served
+    prompts, budgets = workload(seed=37, lengths=(5, 4), budgets=(8, 5))
+    refs = [reference(module, params, p, b)
+            for p, b in zip(prompts, budgets)]
+    clock = FakeClock()
+    replica = ServingReplica(
+        build_for(module, params, rows=1, clock=clock),
+        identity='ramonly', clock=clock,
+        watchdog=StepWatchdog(stall_after=5.0, slow_factor=None),
+        fault=StalledStep(tick=3, action=lambda: clock.advance(10.0)))
+    for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+        replica.submit(Request(f'r{index}', prompt, budget))
+    results = replica.run_until_idle()
+    assert replica.relaunches == 1
+    assert set(replica.report.replayed + replica.report.resubmitted) \
+        == {'r0', 'r1'}
+    for index in range(2):
+        assert results[f'r{index}'].tokens == refs[index], (
+            f'r{index} lost or diverged across the client-less relaunch')
+
+
+def test_engine_exposes_decode_step_wall_seconds(served):
+    module, params = served
+    engine = Engine(module, params, rows=1, block_size=8)
+    assert engine.last_step_seconds == 0.0
+    engine.admit(np.arange(1, 5), max_new=3)
+    engine.step()
+    assert engine.last_step_seconds > 0.0
+
+
+# ---------------------------------------------------------------------------
+# admission control: bounded backlog + watermark shedding by deadline slack
+# ---------------------------------------------------------------------------
+
+
+class TestAdmissionControl:
+
+    def test_max_queued_typed_rejection(self, served):
+        module, params = served
+        engine = Engine(module, params, rows=1, block_size=8)
+        scheduler = Scheduler(engine, max_queued=2)
+        for index in range(2):
+            scheduler.submit(Request(f'q{index}', [1, 2, 3], 4))
+        with pytest.raises(QueueFull, match='max_queued=2'):
+            scheduler.submit(Request('q2', [1, 2, 3], 4))
+        # default stays unbounded; and the bound must be sane
+        assert Scheduler(engine).max_queued is None
+        with pytest.raises(ValueError, match='max_queued'):
+            Scheduler(engine, max_queued=0)
+
+    def test_watermark_validation(self):
+        with pytest.raises(ValueError, match='watermarks'):
+            Watermarks(high=2, low=3)
+        with pytest.raises(ValueError, match='watermarks'):
+            Watermarks(high=0, low=0)
+        assert Watermarks(high=4, low=2).excess(7) == 5
+        assert Watermarks(high=4, low=2).excess(4) == 0
+
+    def test_shed_by_deadline_slack_spares_active_rows(self, served):
+        """Past the high watermark the queue sheds down to the low one:
+        victims by ascending deadline slack (the request that will
+        expire anyway goes first); the ACTIVE row is never shed and
+        stays token-exact."""
+        module, params = served
+        clock = FakeClock()
+        prompts, _ = workload(seed=29, lengths=(5,), budgets=(10,))
+        expected = reference(module, params, prompts[0], 10)
+        engine = Engine(module, params, rows=1, block_size=8)
+        scheduler = Scheduler(engine, clock=clock,
+                              watermarks=Watermarks(high=2, low=1))
+        scheduler.submit(Request('active', prompts[0], 10,
+                                 deadline=0.5))    # seats first: never shed
+        scheduler.step()
+        scheduler.submit(Request('soon', [1, 2, 3], 4, deadline=1.0))
+        scheduler.submit(Request('later', [1, 2, 3], 4, deadline=60.0))
+        scheduler.submit(Request('forever', [1, 2, 3], 4))
+        tick = scheduler.step()                    # depth 3 > high 2
+        shed = [(completion.request.id, slack)
+                for completion, slack in tick.shed]
+        assert shed == [('soon', 1.0), ('later', 60.0)]
+        assert scheduler.results['soon'].reason == 'shed'
+        assert scheduler.backpressure
+        assert scheduler.queue_depth == 1          # 'forever' survived
+        tick = scheduler.step()                    # depth 1 <= low
+        assert not tick.shed and not scheduler.backpressure
+        results = scheduler.run()
+        assert results['active'].tokens == expected
+        assert results['forever'].reason == 'length'
+
+    def test_no_deadline_sheds_newest_first(self, served):
+        """Among no-deadline requests the newest sheds first — the
+        oldest waiters keep their FIFO claim."""
+        module, params = served
+        clock = FakeClock()
+        engine = Engine(module, params, rows=1, block_size=8)
+        scheduler = Scheduler(engine, clock=clock,
+                              watermarks=Watermarks(high=2, low=2))
+        scheduler.submit(Request('seated', [1, 2, 3], 20))
+        scheduler.step()
+        for name in ('old', 'mid', 'new'):
+            scheduler.submit(Request(name, [1, 2, 3], 4))
+            clock.advance(1.0)
+        tick = scheduler.step()
+        assert [completion.request.id
+                for completion, _ in tick.shed] == ['new']
+        assert tick.shed[0][1] is None             # no deadline, no slack
+        assert {'old', 'mid'} <= {p.request.id for p in scheduler._queue}
+
+    def test_service_narrates_loadshed_and_backpressure(self, served):
+        from tpusystem.observe.events import Backpressure, LoadShed
+        from tpusystem.services.prodcon import Consumer, Producer
+
+        module, params = served
+        clock = FakeClock()
+        witnessed = []
+        consumer = Consumer('probe')
+        consumer.register(LoadShed, witnessed.append)
+        consumer.register(Backpressure, witnessed.append)
+        producer = Producer()
+        producer.register(consumer)
+        service = InferenceService(module, params, producer=producer,
+                                   rows=1, block_size=8, clock=clock,
+                                   watermarks=Watermarks(high=1, low=0))
+        service.submit(Request('seated', [1, 2, 3], 30))
+        service.step()
+        service.submit(Request('q1', [1, 2, 3], 4, deadline=2.0))
+        service.submit(Request('q2', [1, 2, 3], 4))
+        service.step()                             # sheds both, engages
+        sheds = [e for e in witnessed if isinstance(e, LoadShed)]
+        assert [e.id for e in sheds] == ['q1', 'q2']
+        assert sheds[0].slack == 2.0 and sheds[1].slack is None
+        # events carry the depth that TRIGGERED the shed (2 > high 1),
+        # not the post-admission depth (0 — would read as no overload)
+        assert all(e.queue_depth == 2 for e in sheds)
+        flags = [e for e in witnessed if isinstance(e, Backpressure)]
+        assert [e.engaged for e in flags] == [True]
+        assert flags[0].queue_depth == 2
+        service.step()                             # empty queue: releases
+        flags = [e for e in witnessed if isinstance(e, Backpressure)]
+        assert [e.engaged for e in flags] == [True, False]
+        service.cancel('seated')
+
+
+# ---------------------------------------------------------------------------
+# the injectable clock: deadline/expiry edges with zero real sleeps
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_expiry_runs_on_the_fake_clock(served):
+    """The satellite: wall time enters the scheduler ONLY through
+    clock=, so deadline starvation drills advance a number instead of
+    sleeping."""
+    module, params = served
+    clock = FakeClock()
+    engine = Engine(module, params, rows=1, block_size=8)
+    scheduler = Scheduler(engine, clock=clock)
+    scheduler.submit(Request('hog', [1, 2, 3, 4], 10))
+    scheduler.submit(Request('starved', [1, 2, 3, 4], 4, deadline=5.0))
+    tick = scheduler.step()
+    assert tick.queue_depth == 1 and not tick.expired
+    clock.advance(5.0)
+    tick = scheduler.step()
+    assert [(completion.request.id, where)
+            for completion, where in tick.expired] == [('starved', 'queued')]
+    assert scheduler.results['starved'].seconds == 5.0
+
+
+def test_cancel_landing_the_same_tick_as_completion(served):
+    """Edge: the cancel arrives on the tick the request completes —
+    cancel() must answer None (already done), the 'length' completion
+    stands, and the row is already free for the queue."""
+    module, params = served
+    engine = Engine(module, params, rows=1, block_size=8)
+    scheduler = Scheduler(engine)
+    scheduler.submit(Request('a', [1, 2, 3, 4], 3))
+    scheduler.step()                   # admit emits token 1, decode token 2
+    tick = scheduler.step()            # token 3: completes
+    assert [completion.request.id
+            for completion in tick.completed] == ['a']
+    assert scheduler.cancel('a') is None
+    assert scheduler.results['a'].reason == 'length'
+    assert engine.free_rows == 1
+    # and the degenerate flavor: completion at the ADMISSION tick
+    scheduler.submit(Request('b', [1, 2, 3], 1))   # max_new=1: done at admit
+    tick = scheduler.step()
+    assert tick.completed[0].request.id == 'b'
+    assert scheduler.cancel('b') is None
+    assert scheduler.results['b'].reason == 'length'
+
+
+def test_deadline_expiring_exactly_at_the_admission_tick(served):
+    """Edge: the deadline lands exactly on the tick that would have
+    admitted the request — expiry (>=) wins before admission, the
+    request retires 'expired' with zero tokens even though a row was
+    free."""
+    module, params = served
+    clock = FakeClock()
+    engine = Engine(module, params, rows=2, block_size=8)
+    scheduler = Scheduler(engine, clock=clock)
+    scheduler.submit(Request('edge', [1, 2, 3], 4, deadline=1.0))
+    clock.advance(1.0)                             # exactly the deadline
+    tick = scheduler.step()
+    assert [(completion.request.id, where)
+            for completion, where in tick.expired] == [('edge', 'queued')]
+    assert scheduler.results['edge'].tokens == []
+    assert not tick.admitted and engine.free_rows == 2
+
+
+def test_expiry_of_a_request_whose_row_is_mid_prefill(served):
+    """Edge: the deadline passes while the row is being seated (the
+    prefill consumed the remaining slack) — the admission emits its
+    first token, then the NEXT tick's expiry evicts the row 'active'
+    with that partial output kept and the neighbor token-exact."""
+    module, params = served
+    clock = FakeClock()
+    prompts, _ = workload(seed=31, lengths=(6,), budgets=(8,))
+    expected = reference(module, params, prompts[0], 8)
+    engine = Engine(module, params, rows=2, block_size=8)
+    scheduler = Scheduler(engine, clock=clock)
+    scheduler.submit(Request('keep', prompts[0], 8))
+    scheduler.submit(Request('doomed', [1, 2, 3, 4], 20, deadline=2.0))
+
+    original_admit = engine.admit
+
+    def slow_admit(prompt, max_new, **kwargs):
+        if kwargs.get('tag') == 'doomed':          # prefill eats the slack
+            clock.advance(2.0)
+        return original_admit(prompt, max_new, **kwargs)
+
+    engine.admit = slow_admit
+    tick = scheduler.step()                        # both seated
+    assert len(tick.admitted) == 2 and not tick.expired
+    tick = scheduler.step()
+    assert [(completion.request.id, where)
+            for completion, where in tick.expired] == [('doomed', 'active')]
+    doomed = scheduler.results['doomed']
+    assert doomed.reason == 'expired'
+    assert 1 <= len(doomed.tokens) < 20            # the admission token(s)
+    engine.admit = original_admit
+    results = scheduler.run()
+    assert results['keep'].tokens == expected
+
+
+# ---------------------------------------------------------------------------
+# observability: the failover events chart like everything else
+# ---------------------------------------------------------------------------
+
+
+def test_tensorboard_failover_handlers_chart_the_events(tmp_path):
+    from tpusystem.observe.events import (Backpressure, EngineRestarted,
+                                          LoadShed)
+    from tpusystem.observe.tensorboard import (SummaryWriter,
+                                               tensorboard_consumer, writer)
+
+    consumer = tensorboard_consumer()
+    board = SummaryWriter(tmp_path)
+    consumer.dependency_overrides[writer] = lambda: board
+    consumer.consume(EngineRestarted(cause='stalled', replayed=2,
+                                     resubmitted=1, seconds=0.8))
+    consumer.consume(LoadShed(id='r9', produced=0, queue_depth=7,
+                              slack=-0.5))
+    consumer.consume(Backpressure(engaged=True, queue_depth=7))
+    board.flush()
+    events = list(tmp_path.glob('events.out.tfevents.*'))
+    assert events and events[0].stat().st_size > 120
+
+
+# ---------------------------------------------------------------------------
+# the real thing: SIGKILL under the Supervisor (subprocess drill)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sigkill_subprocess_drill_under_supervisor():
+    """The dryrun stage as a test: a serving worker subprocess SIGKILLs
+    itself mid-decode, the Supervisor relaunches it (signal death =
+    worker-lost), the relaunch recovers the journal from the
+    supervisor's memstore and finishes — completions token-exact vs an
+    uninterrupted run of the same worker, decode compiled once."""
+    from __graft_entry__ import _dryrun_serve_failover
+    _dryrun_serve_failover(2)

@@ -169,6 +169,54 @@ class ServeStepped:
     tokens_per_sec: float
 
 
+@event
+class LoadShed:
+    """Admission control shed a queued request past the high watermark
+    (:class:`tpusystem.serve.Watermarks`): ``slack`` is the seconds it
+    had left before its deadline when shed (negative = already past,
+    None = no deadline — shed last, newest first). Active rows are never
+    shed."""
+    id: str
+    produced: int
+    queue_depth: int
+    slack: float | None
+
+
+@event
+class Backpressure:
+    """The scheduler crossed its queue watermarks: ``engaged`` True past
+    the high mark (upstream should route elsewhere), False once the
+    backlog drained back to the low mark."""
+    engaged: bool
+    queue_depth: int
+
+
+@event
+class RequestReplayed:
+    """An engine relaunch re-queued a journaled request: ``prefix`` is
+    how many already-emitted tokens replay re-prefills (``where='hot'``)
+    before decode resumes; 0 / ``where='cold'`` is the re-submit of a
+    request the journal only knew as queued. Greedy decode is
+    deterministic, so either way the final completion is token-exact
+    against an uninterrupted run."""
+    id: str
+    prefix: int
+    where: str                       # 'hot' | 'cold'
+    waited: float
+
+
+@event
+class EngineRestarted:
+    """A serving replica rebuilt its engine and replayed its journal —
+    ``cause`` is ``'relaunch'`` (a fresh process found a recoverable
+    journal: the supervised-relaunch path) or ``'stalled'`` (the step
+    watchdog fired in-process); ``seconds`` is rebuild + replay."""
+    cause: str
+    replayed: int
+    resubmitted: int
+    seconds: float
+
+
 # --------------------------------------------------------------------------
 # supervisor events — the recovery control loop
 # (tpusystem.parallel.supervisor) narrates every worker exit, relaunch and

@@ -27,11 +27,13 @@ import struct
 import time
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
-                                      ElasticTimeline, RecoveryTimeline,
-                                      RecsysEvaluated, ReplicaDiverged,
-                                      RequestAdmitted, RequestExpired,
-                                      RolledBack, ServeStepped, Trained,
-                                      Validated, WorkerExited, WorldResized)
+                                      Backpressure, ElasticTimeline,
+                                      EngineRestarted, LoadShed,
+                                      RecoveryTimeline, RecsysEvaluated,
+                                      ReplicaDiverged, RequestAdmitted,
+                                      RequestExpired, RolledBack,
+                                      ServeStepped, Trained, Validated,
+                                      WorkerExited, WorldResized)
 from tpusystem.services.prodcon import Consumer, Depends
 
 # ---------------------------------------------------------------- crc32c ---
@@ -259,6 +261,47 @@ def tensorboard_consumer() -> Consumer:
                          expire_counts[0])
         board.add_scalar(f'serve/expired_waited_{event.where}',
                          event.waited, expire_counts[0])
+
+    # serving failover: engine relaunches (recovery MTTR + how many rows
+    # replayed hot vs resubmitted cold), watermark sheds, and the
+    # backpressure flag — a chaos incident or an overload wave reads
+    # straight off the dashboard. Restarts and sheds have no global
+    # step, so they chart against their own counters.
+    restart_counts = [0]
+    shed_counts = [0]
+    backpressure_counts = [0]
+
+    @consumer.handler
+    def on_engine_restarted(event: EngineRestarted,
+                            board: SummaryWriter = Depends(writer)) -> None:
+        restart_counts[0] += 1
+        board.add_scalar('serve/recovery_seconds', event.seconds,
+                         restart_counts[0])
+        board.add_scalar('serve/replayed', float(event.replayed),
+                         restart_counts[0])
+        board.add_scalar('serve/resubmitted', float(event.resubmitted),
+                         restart_counts[0])
+
+    @consumer.handler
+    def on_load_shed(event: LoadShed,
+                     board: SummaryWriter = Depends(writer)) -> None:
+        # per shed event (x = shed counter): the queue depth that
+        # triggered it — how overloaded the replica actually was — and
+        # the victim's remaining deadline slack where it had one
+        shed_counts[0] += 1
+        board.add_scalar('serve/shed', float(event.queue_depth or 0),
+                         shed_counts[0])
+        if event.slack is not None:
+            board.add_scalar('serve/shed_slack', event.slack,
+                             shed_counts[0])
+
+    @consumer.handler
+    def on_backpressure(event: Backpressure,
+                        board: SummaryWriter = Depends(writer)) -> None:
+        backpressure_counts[0] += 1
+        board.add_scalar('serve/backpressure',
+                         1.0 if event.engaged else 0.0,
+                         backpressure_counts[0])
 
     @consumer.handler
     def on_recovery(event: RecoveryTimeline,

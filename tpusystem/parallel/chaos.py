@@ -15,7 +15,13 @@ case instead of a hand-crafted one-off:
   kill-at-step-k → restart → step-granular-resume drill);
 * blob-plane faults (dropped or truncated chunks of a hot-state replica,
   ``Faults(truncate=...)`` / ``kinds=('blob',)``) — the transfers the
-  supervisor's memstore replication rides must *detect* every torn copy.
+  supervisor's memstore replication rides must *detect* every torn copy
+  (the serving engine's request-journal replication rides the same plane,
+  so the same faults drill it);
+* serving-step stalls (:class:`StalledStep` — a decode step that hangs or
+  runs anomalously slow at a chosen tick, the wedge the step watchdog
+  must turn into a typed ``EngineStalled`` → restart-and-replay;
+  :class:`DieAtStep` doubles as the kill-at-tick-k serving fault).
 
 Determinism: every fault decision is drawn in frame order from one
 ``random.Random(seed)`` per :class:`Faults` instance, and frames of one
@@ -42,7 +48,8 @@ from typing import Any, Callable
 from tpusystem.parallel.multihost import Hub, TcpTransport
 
 __all__ = ['Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
-           'PreemptionWave', 'CorruptGrads', 'CorruptBatch', 'FlipParamBit']
+           'PreemptionWave', 'StalledStep', 'CorruptGrads', 'CorruptBatch',
+           'FlipParamBit']
 
 
 @dataclass
@@ -249,6 +256,40 @@ class DieAtStep:
             os._exit(self.code)
         else:
             raise WorkerKilled(self.step)
+
+
+@dataclass
+class StalledStep:
+    """Scripted serving-step stall at a chosen scheduler tick — the
+    hung/anomalously-slow decode the step watchdog
+    (:class:`tpusystem.serve.StepWatchdog`) must classify as
+    ``EngineStalled`` instead of wedging forever.
+
+    Wire it as a serving loop's fault seam (the 1-based upcoming tick,
+    the :class:`DieAtStep` convention)::
+
+        replica = ServingReplica(build, fault=StalledStep(tick=4,
+                                                          seconds=2.0))
+
+    ``action`` defaults to a real ``time.sleep(seconds)`` — the genuine
+    article for wall-clock watchdogs. Tests pass a callable instead
+    (advance a fake clock, or raise the stall directly) so tier-1 drills
+    the verdict with zero real sleeps. Fires once.
+    """
+
+    tick: int
+    seconds: float = 0.0
+    action: Callable[[], None] | None = None
+    fired: bool = field(default=False, init=False)
+
+    def __call__(self, current_tick: int) -> None:
+        if self.fired or current_tick != self.tick:
+            return
+        self.fired = True
+        if self.action is not None:
+            self.action()
+        else:
+            time.sleep(self.seconds)
 
 
 @dataclass

@@ -212,6 +212,12 @@ class Supervisor:
     # the two flows unmistakable on the wire). 'own:{identity}' asks for
     # the peer's OWN local slot — the elastic reshard's survivor fetch
     # (tpusystem.parallel.elastic.collect_pieces), again key-distinct.
+    # The serving engine's request journal rides this machinery unchanged
+    # under the identity namespace 'journal:{identity}'
+    # (tpusystem.serve.failover): its pushes replicate to the buddy and a
+    # replaced host's fetch pulls it back exactly like hot training state
+    # — the identity prefix keeps journal slots from ever colliding with
+    # the same run's TrainState slots.
 
     def _replicate(self, identity: str, entry: Any) -> None:
         """Queue a verified push for cross-host replication.

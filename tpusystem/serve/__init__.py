@@ -19,20 +19,33 @@ Layers, bottom up:
 * :class:`Scheduler` / :class:`Request` — prefill/decode phase packing
   under a token budget (:mod:`tpusystem.serve.scheduler`);
 * :class:`InferenceService` — the command/event bus front door
-  (:mod:`tpusystem.serve.service`).
+  (:mod:`tpusystem.serve.service`);
+* the failover layer (:mod:`tpusystem.serve.failover`) — the journaled
+  request log, token-prefix replay, step watchdog, and watermark load
+  shedding that let a replica survive kill, hang, and overload
+  (:class:`ServingReplica` is the supervised loop; docs/serving.md
+  "Surviving engine failure").
 """
 
 from tpusystem.serve.engine import (Admission, Engine, Saturated,
                                     StepReport, engine_unsupported_reason,
                                     prefill_bucket)
+from tpusystem.serve.failover import (EngineStalled, JournalCorrupt,
+                                      ReplayReport, RequestJournal,
+                                      ServingReplica, StepWatchdog,
+                                      Watermarks, journal_identity,
+                                      recover_journal, replay)
 from tpusystem.serve.kvcache import (TRASH_BLOCK, PagedKVCache,
                                      adopt_prefill, write_tables)
-from tpusystem.serve.scheduler import (Completion, Request, Scheduler,
-                                       Tick, serve_levers)
+from tpusystem.serve.scheduler import (Completion, QueueFull, Request,
+                                       Scheduler, Tick, serve_levers)
 from tpusystem.serve.service import InferenceService
 
 __all__ = ['Engine', 'Admission', 'StepReport', 'Saturated',
            'engine_unsupported_reason', 'prefill_bucket',
            'PagedKVCache', 'TRASH_BLOCK', 'adopt_prefill', 'write_tables',
            'Scheduler', 'Request', 'Completion', 'Tick', 'serve_levers',
-           'InferenceService']
+           'QueueFull', 'InferenceService',
+           'EngineStalled', 'JournalCorrupt', 'RequestJournal',
+           'ReplayReport', 'ServingReplica', 'StepWatchdog', 'Watermarks',
+           'journal_identity', 'recover_journal', 'replay']

@@ -193,6 +193,12 @@ class Engine:
         self._prefills: dict[int, object] = {}   # unhashable-module path
         self.trace_count = 0
         self.timings = {'prefill': 0.0, 'admit': 0.0, 'step': 0.0}
+        # wall seconds of the most recent decode dispatch (admission and
+        # prefill excluded) — the decode-only probe for a custom serving
+        # loop that wants to feed failover.StepWatchdog.observe the step
+        # alone (ServingReplica's built-in watchdog watches the whole
+        # tick on its injectable clock instead)
+        self.last_step_seconds = 0.0
 
         def step_fn(params, cache, tokens, active):
             self.trace_count += 1            # runs at trace time only
@@ -305,7 +311,8 @@ class Engine:
         # retired rows' stale device token stays as-is (in-vocab junk an
         # inactive row may keep embedding — masked, never emitted)
         self._tokens_dev = token_dev
-        self.timings['step'] += time.perf_counter() - started
+        self.last_step_seconds = time.perf_counter() - started
+        self.timings['step'] += self.last_step_seconds
         emitted, finished = {}, []
         for row in np.flatnonzero(self._active):
             row = int(row)

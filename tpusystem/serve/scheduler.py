@@ -9,10 +9,23 @@ retirements back to requests. A request the free-list cannot seat stays
 queued (never crashes — the ``Saturated`` contract), and drains in as
 rows and blocks free up.
 
+Overload is bounded and typed: ``max_queued`` rejects at submit with
+:exc:`QueueFull` once the backlog is full (unbounded by default — the
+pre-existing contract), and :class:`~tpusystem.serve.failover.Watermarks`
+sheds queued requests by deadline slack past the high watermark (the
+request that will expire anyway goes first; active rows are never shed).
+Wall time enters ONLY through the injectable ``clock`` — deadline
+expiry, shedding slack, and every Completion's latency run on a fake
+clock in tier-1 with zero real sleeps (the ``Supervisor``
+injectable-clock discipline).
+
 The engine keeps the PR-7 serving levers (``stream_dtype`` weight
 streaming); :func:`serve_levers` picks the fastest defaults for the
 current backend so serving rides the quantized streaming path on HBM-
-bound chips without per-deployment tuning.
+bound chips without per-deployment tuning. An attached
+:class:`~tpusystem.serve.failover.RequestJournal` (``scheduler.journal``)
+witnesses every lifecycle transition for the kill/replay drill —
+docs/serving.md "Surviving engine failure".
 """
 
 from __future__ import annotations
@@ -20,10 +33,12 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import deque
+from typing import Callable
 
 import jax
 
 from tpusystem.serve.engine import Engine
+from tpusystem.serve.failover import RequestJournal, Watermarks  # noqa: F401
 
 
 def serve_levers() -> dict:
@@ -38,6 +53,13 @@ def serve_levers() -> dict:
     if jax.default_backend() in ('tpu', 'axon'):
         return {'stream_dtype': 'int8'}
     return {'stream_dtype': 'auto'}
+
+
+class QueueFull(RuntimeError):
+    """The backlog is at ``max_queued`` — a typed rejection the caller
+    (or a fronting router) handles by retrying elsewhere or later.
+    Distinct from ``ValueError`` (a request that could never run) and
+    from silent queueing (unbounded RAM under sustained overload)."""
 
 
 @dataclasses.dataclass
@@ -63,13 +85,17 @@ class Request:
 class _Pending:
     request: Request
     submitted: float
+    # tokens already emitted before an engine relaunch (the journal
+    # replay path): the engine re-prefills prompt + prefix and the final
+    # Completion is prefix + resumed tokens — token-exact under greedy
+    prefix: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
 class Completion:
     request: Request
     tokens: list
-    reason: str                      # 'length' | 'stop' | 'cancelled' | 'expired'
+    reason: str          # 'length' | 'stop' | 'cancelled' | 'expired' | 'shed'
     seconds: float                   # submit -> completion
 
 
@@ -83,6 +109,12 @@ class Tick:
     active: int
     expired: list = dataclasses.field(default_factory=list)
     # [(Completion, 'queued' | 'active'), ...] — deadline expiries this step
+    shed: list = dataclasses.field(default_factory=list)
+    # [(Completion, slack_seconds | None), ...] — watermark sheds this step
+    shed_depth: int | None = None
+    # the queue depth that TRIGGERED the shed (pre-shed, post-expiry) —
+    # the final queue_depth is post-admission and would misreport the
+    # overload the LoadShed/Backpressure events narrate
 
 
 class Scheduler:
@@ -94,11 +126,31 @@ class Scheduler:
             step. At least one admission always proceeds when capacity
             exists, so a prompt wider than the whole budget cannot
             starve.
+        clock: wall-time source (``time.monotonic``). Injectable so
+            deadline-expiry, shedding and watchdog tests run on a fake
+            clock with zero real sleeps.
+        max_queued: backlog bound — submissions past it raise
+            :exc:`QueueFull`. None (default) keeps the pre-existing
+            unbounded behavior.
+        watermarks: a :class:`~tpusystem.serve.failover.Watermarks`
+            high/low pair for deadline-slack load shedding, or None
+            (default: never shed).
     """
 
-    def __init__(self, engine: Engine, *, prefill_budget: int = 512) -> None:
+    def __init__(self, engine: Engine, *, prefill_budget: int = 512,
+                 clock: Callable[[], float] = time.monotonic,
+                 max_queued: int | None = None,
+                 watermarks: Watermarks | None = None) -> None:
+        if max_queued is not None and max_queued < 1:
+            raise ValueError(f'max_queued must be >= 1 (or None for '
+                             f'unbounded), got {max_queued}')
         self.engine = engine
         self.prefill_budget = prefill_budget
+        self.max_queued = max_queued
+        self.watermarks = watermarks
+        self.journal: RequestJournal | None = None
+        self.backpressure = False
+        self._clock = clock
         self._queue: deque[_Pending] = deque()
         self._seated: dict[int, _Pending] = {}      # row -> pending
         self.results: dict[str, Completion] = {}
@@ -119,7 +171,8 @@ class Scheduler:
     def submit(self, request: Request) -> None:
         """Queue a request. Requests that could NEVER fit (prompt +
         max_new over the cache capacity) are refused immediately with a
-        ``ValueError`` instead of clogging the queue forever."""
+        ``ValueError`` instead of clogging the queue forever; a full
+        backlog (``max_queued``) refuses with :exc:`QueueFull`."""
         prompt_len = len(request.prompt)
         if prompt_len < 1 or request.max_new < 1:
             raise ValueError('a request needs a non-empty prompt and '
@@ -140,7 +193,38 @@ class Scheduler:
             raise ValueError(
                 f'request {request.id!r} needs {needed} blocks but the '
                 f'pool has {self.engine.pool.blocks - 1} allocatable')
-        self._queue.append(_Pending(request, time.monotonic()))
+        if (self.max_queued is not None
+                and len(self._queue) >= self.max_queued):
+            raise QueueFull(
+                f'request {request.id!r} rejected: backlog is at '
+                f'max_queued={self.max_queued} — retry later or on '
+                f'another replica')
+        pending = _Pending(request, self._clock())
+        self._queue.append(pending)
+        if self.journal is not None:
+            self.journal.record(request, pending.submitted)
+
+    def restore(self, request: Request, *, waited: float = 0.0,
+                prefix=()) -> None:
+        """Re-queue a journaled request after an engine relaunch (the
+        :func:`tpusystem.serve.failover.replay` entry): ``prefix`` is the
+        tokens already emitted before the failure — admission re-prefills
+        ``prompt + prefix`` and decodes the remaining budget, and the
+        final Completion is ``prefix + resumed tokens`` (token-exact
+        under greedy decode). ``waited`` backdates the submission so
+        deadline and latency accounting stay truthful across the
+        relaunch (outage time between the last journal push and the
+        relaunch is not counted — the journal packs waited-seconds)."""
+        prefix = [int(token) for token in prefix]
+        if len(prefix) >= request.max_new:
+            raise ValueError(
+                f'request {request.id!r} already emitted {len(prefix)} of '
+                f'max_new={request.max_new} tokens — a finished request '
+                f'has no business in the journal')
+        pending = _Pending(request, self._clock() - waited, prefix)
+        self._queue.append(pending)
+        if self.journal is not None:
+            self.journal.restored(request, pending.submitted, prefix)
 
     def cancel(self, request_id: str) -> str | None:
         """Cancel a request wherever it is: ``'queued'`` (silently
@@ -150,14 +234,14 @@ class Scheduler:
         for pending in list(self._queue):
             if pending.request.id == request_id:
                 self._queue.remove(pending)
+                if self.journal is not None:
+                    self.journal.finished(request_id)
                 return 'queued'
         for row, pending in list(self._seated.items()):
             if pending.request.id == request_id:
                 state = self.engine.evict(row)
                 del self._seated[row]
-                self.results[request_id] = Completion(
-                    pending.request, list(state.tokens), 'cancelled',
-                    time.monotonic() - pending.submitted)
+                self._complete(pending, list(state.tokens), 'cancelled')
                 return 'active'
         return None
 
@@ -166,7 +250,7 @@ class Scheduler:
         dropped (never seated — saturation starvation made visible);
         active ones are evicted mid-decode, partial tokens kept. Returns
         ``[(Completion, where), ...]`` for the tick."""
-        now = time.monotonic()
+        now = self._clock()
         expired = []
         for pending in list(self._queue):
             deadline = pending.request.deadline
@@ -183,29 +267,74 @@ class Scheduler:
                                                'expired'), 'active'))
         return expired
 
+    def _slack(self, pending: _Pending, now: float) -> float | None:
+        """Seconds until the request's deadline (negative = already
+        past); None when it has no deadline."""
+        deadline = pending.request.deadline
+        if deadline is None:
+            return None
+        return deadline - (now - pending.submitted)
+
+    def _shed(self) -> list:
+        """Past the high watermark, shed queued requests down to the low
+        one by deadline slack — the request that will expire anyway goes
+        first; no-deadline requests shed last, newest-first, so the
+        oldest waiters keep their FIFO claim. Active rows are never shed
+        (sunk prefill, closest to done). Returns
+        ``[(Completion, slack), ...]`` and maintains the backpressure
+        flag (engaged past high, released at/below low)."""
+        if self.watermarks is None:
+            return []
+        depth = len(self._queue)
+        excess = self.watermarks.excess(depth)
+        if not excess:
+            if self.backpressure and depth <= self.watermarks.low:
+                self.backpressure = False
+            return []
+        self.backpressure = True
+        now = self._clock()
+        order = sorted(
+            self._queue,
+            key=lambda pending: (
+                (0, self._slack(pending, now))
+                if pending.request.deadline is not None
+                else (1, -pending.submitted)))
+        shed = []
+        for pending in order[:excess]:
+            self._queue.remove(pending)
+            shed.append((self._complete(pending, [], 'shed'),
+                         self._slack(pending, now)))
+        return shed
+
     def step(self) -> Tick:
-        """One serving iteration: expire past-deadline requests, admit
-        within the prefill budget, then decode every seated row once."""
+        """One serving iteration: expire past-deadline requests, shed
+        past the watermark, admit within the prefill budget, then decode
+        every seated row once."""
         self.steps += 1
         expired = self._expire()
+        depth_at_shed = len(self._queue)
+        shed = self._shed()
         admitted, completed = [], []
         budget = self.prefill_budget
         while self._queue:
             pending = self._queue[0]
             request = pending.request
-            cost = self.engine.bucket(len(request.prompt))
+            prompt = list(request.prompt) + pending.prefix
+            remaining = request.max_new - len(pending.prefix)
+            cost = self.engine.bucket(len(prompt))
             if cost > budget and budget < self.prefill_budget:
                 break                    # budget spent this step
-            if not self.engine.can_admit(len(request.prompt),
-                                         request.max_new):
+            if not self.engine.can_admit(len(prompt), remaining):
                 break                    # FIFO: wait for rows/blocks
             self._queue.popleft()
             admission = self.engine.admit(
-                request.prompt, request.max_new,
+                prompt, remaining,
                 stop_token=request.stop_token, tag=request.id)
             budget -= cost
-            ttft = time.monotonic() - pending.submitted
+            ttft = self._clock() - pending.submitted
             admitted.append((request, admission, ttft))
+            if self.journal is not None:
+                self.journal.seated(request.id, admission.token)
             if admission.finished:
                 completed.append(self._complete(
                     pending, [admission.token], admission.reason))
@@ -216,7 +345,10 @@ class Scheduler:
         emitted = {}
         for row, token in report.emitted.items():
             if row in self._seated:
-                emitted[self._seated[row].request.id] = token
+                request_id = self._seated[row].request.id
+                emitted[request_id] = token
+                if self.journal is not None:
+                    self.journal.append(request_id, token)
         for row, reason, tokens in report.finished:
             # rows admitted directly on the engine (not through this
             # scheduler) retire without a seat here — their caller got
@@ -225,14 +357,19 @@ class Scheduler:
             if pending is not None:
                 completed.append(self._complete(pending, list(tokens),
                                                 reason))
+        if self.journal is not None:
+            self.journal.observe_tick()
         return Tick(admitted, emitted, completed, len(self._queue),
-                    len(self._seated), expired)
+                    len(self._seated), expired, shed,
+                    depth_at_shed if shed else None)
 
     def _complete(self, pending: _Pending, tokens: list,
                   reason: str) -> Completion:
-        completion = Completion(pending.request, tokens, reason,
-                                time.monotonic() - pending.submitted)
+        completion = Completion(pending.request, pending.prefix + list(tokens),
+                                reason, self._clock() - pending.submitted)
         self.results[pending.request.id] = completion
+        if self.journal is not None:
+            self.journal.finished(pending.request.id)
         return completion
 
     def run(self, max_steps: int = 10_000) -> dict:

@@ -19,7 +19,8 @@ from __future__ import annotations
 
 import time
 
-from tpusystem.observe.events import (RequestAdmitted, RequestCompleted,
+from tpusystem.observe.events import (Backpressure, LoadShed,
+                                      RequestAdmitted, RequestCompleted,
                                       RequestEvicted, RequestExpired,
                                       ServeStepped)
 from tpusystem.serve.engine import Engine
@@ -42,15 +43,19 @@ class InferenceService:
     def __init__(self, module, params, *, producer: Producer | None = None,
                  rows: int = 4, block_size: int = 16,
                  blocks: int | None = None, prefill_budget: int = 512,
-                 **levers) -> None:
+                 clock=time.monotonic, max_queued: int | None = None,
+                 watermarks=None, **levers) -> None:
         knobs = {**serve_levers(), **levers}
         self.engine = Engine(module, params, rows=rows,
                              block_size=block_size, blocks=blocks, **knobs)
         self.scheduler = Scheduler(self.engine,
-                                   prefill_budget=prefill_budget)
+                                   prefill_budget=prefill_budget,
+                                   clock=clock, max_queued=max_queued,
+                                   watermarks=watermarks)
         self.producer = producer or Producer()
         self._emitted = 0
         self._started = None         # first-step wall clock, for tok/s
+        self._backpressure = False   # last narrated watermark state
         self.service = Service('serve')
         self.service.handler(self._named('submit', self.submit))
         self.service.handler(self._named('cancel', self.cancel))
@@ -88,6 +93,21 @@ class InferenceService:
         if self._started is None:
             self._started = time.monotonic()
         tick = self.scheduler.step()
+        # shed/backpressure narrate the depth that TRIGGERED them
+        # (tick.shed_depth, pre-shed) — the final queue_depth is
+        # post-admission and would under-report the overload
+        for completion, slack in tick.shed:
+            self.producer.dispatch(LoadShed(
+                id=completion.request.id,
+                produced=len(completion.tokens),
+                queue_depth=tick.shed_depth, slack=slack))
+        if self.scheduler.backpressure != self._backpressure:
+            self._backpressure = self.scheduler.backpressure
+            self.producer.dispatch(Backpressure(
+                engaged=self._backpressure,
+                queue_depth=(tick.shed_depth if self._backpressure
+                             and tick.shed_depth is not None
+                             else tick.queue_depth)))
         for completion, where in tick.expired:
             self.producer.dispatch(RequestExpired(
                 id=completion.request.id, where=where,
