@@ -27,6 +27,9 @@ through the serving engine, ``benchmarks/serve_bench.py headline``),
 then the ``serve_recovery_seconds`` row (kill -> first replayed token
 through the serving failover layer, hot journal replay vs cold
 re-submit, ``benchmarks/serve_recovery.py headline``),
+then the ``fleet_recovery_seconds`` row (kill one of three routed
+replicas -> first rerouted token on a survivor, journal handoff vs
+routing-table cold re-submit, ``benchmarks/serve_fleet.py headline``),
 then the ``embedding_lookup_speedup`` row (the recommender workload's
 fused Pallas lookup vs the ``jnp.take`` fallback,
 ``benchmarks/embedding_bench.py headline``),
@@ -177,6 +180,17 @@ def serve_recovery_row() -> None:
     finish token-exact, the hot arm skips re-decoding already-delivered
     tokens)."""
     _overlap_probe_row('serve_recovery.py', 'serve_recovery_seconds')
+
+
+def fleet_recovery_row() -> None:
+    """The fleet-failover recovery row: wall seconds from killing one of
+    three serving replicas mid-stream to the first token a rerouted
+    request emits on a SURVIVOR, journal handoff (hot prefixes onto a
+    different engine) vs routing-table cold re-submit
+    (`benchmarks/serve_fleet.py headline`; the Router redistribution of
+    `tpusystem/serve/fleet.py` — both arms drain token-exact vs an
+    uninterrupted fleet)."""
+    _overlap_probe_row('serve_fleet.py', 'fleet_recovery_seconds')
 
 
 BATCH, SEQ = 16, 1024
@@ -452,5 +466,6 @@ if __name__ == '__main__':
     decode_rows()
     serve_row()
     serve_recovery_row()
+    fleet_recovery_row()
     embedding_row()
     main()

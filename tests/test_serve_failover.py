@@ -375,6 +375,54 @@ def test_unrecoverable_journal_serves_fresh_traffic(served):
                                                budgets[0])
 
 
+def test_replica_b_adopts_replica_a_journal_token_exact(served):
+    """Cross-host handoff onto a DIFFERENT identity: replica A dies for
+    good and replica B — its own identity, its own engine, mid-serving
+    its own traffic — adopts ``journal:{A}`` through the recovery chain
+    (A's supervisor RAM here; the buddy's replica slot on a real pod)
+    and replays A's rows. Greedy decode is deterministic, so A's seated
+    rows resume from their prefixes TOKEN-EXACT on B's engine — the
+    fleet router's redistribution move, drilled at the failover layer."""
+    module, params = served
+    prompts, budgets = workload(seed=23)
+    refs = [reference(module, params, p, b)
+            for p, b in zip(prompts, budgets)]
+    store_a = MemStore()
+    build = build_for(module, params)
+    replica_a = ServingReplica(build, identity='A', client=store_a,
+                               cadence=1)
+    for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+        replica_a.submit(Request(f'a{index}', prompt, budget))
+    for _ in range(3):
+        replica_a.step()             # seats a0/a1, emits prefixes
+    # A is SIGKILLed (objects abandoned); B is a different replica with
+    # its own identity and journal, already serving its own request
+    replica_b = ServingReplica(build, identity='B', client=MemStore(),
+                               cadence=1)
+    assert not replica_b.recovered   # B's OWN journal has nothing
+    b_prompt, b_budget = prompts[0][::-1], 6
+    replica_b.submit(Request('b0', b_prompt, b_budget))
+    replica_b.step()
+    recovered = recover_journal('A', (store_a,))
+    assert recovered is not None
+    tick, rows = recovered
+    report = replay(replica_b.scheduler, rows)
+    assert set(report.replayed) == {'a0', 'a1'}   # hot, from A's prefixes
+    assert report.resubmitted == ['a2']           # queued-only: cold
+    results = replica_b.run_until_idle()
+    for index in range(3):
+        got = results[f'a{index}']
+        assert got.tokens == refs[index], (
+            f'a{index} diverged replaying on a different identity')
+        assert got.reason == 'length'
+    # B's own traffic is untouched by the adoption
+    assert results['b0'].tokens == reference(module, params, b_prompt,
+                                             b_budget)
+    # and the adopted rows now journal under B, so a LATER death of B
+    # hands them on again (the chain composes)
+    assert set(replica_b.scheduler.journal.rows) == set()   # all done
+
+
 def test_restore_rejects_finished_rows(served):
     module, params = served
     scheduler = build_for(module, params)()

@@ -600,6 +600,64 @@ class TestMemStoreWire:
         assert caplog.text.count('supervisor unreachable') == 1  # logged once
         client.close()
 
+    @staticmethod
+    def _rebind(store, host, port):
+        """A restarted supervisor re-listens at its old address; the
+        kernel frees the port as soon as the dead client socket's FIN
+        lands (the client's redial machinery closed it), which can race
+        an immediate rebind by a few ms — retry like a real relaunch."""
+        deadline = time.monotonic() + 5
+        while True:
+            try:
+                return MemStoreServer(store, host=host, port=port)
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.02)
+
+    def test_bounced_supervisor_redial_resumes_pushes(self):
+        """The permanent-degradation regression: a supervisor that
+        RESTARTS listens at the same address again, and the client must
+        redial it — journal/hot-state pushes resume instead of silently
+        freezing durability for the rest of the run."""
+        store = MemStore()
+        server = MemStoreServer(store)
+        host, port = server.address
+        client = MemStoreClient(server.address, redial_backoff=0.0)
+        try:
+            assert client.push(IDENTITY, 1, b'before the bounce') is True
+            server.close()               # the supervisor dies...
+            assert client.push(IDENTITY, 2, b'into the void') is False
+            store = MemStore()           # ... and is relaunched fresh
+            server = self._rebind(store, host, port)
+            # the next call redials (backoff 0) and durability resumes
+            assert client.push(IDENTITY, 3, b'after the bounce') is True
+            assert store.newest(IDENTITY).step == 3
+            fetched = client.fetch(IDENTITY)
+            assert fetched.step == 3 and fetched.blob == b'after the bounce'
+        finally:
+            client.close()
+            server.close()
+
+    def test_redial_budget_is_bounded(self):
+        """The redial ladder is capped per outage: once the budget is
+        spent the client degrades permanently (the old contract) —
+        even a healthy supervisor at the address is not re-dialed."""
+        server = MemStoreServer()
+        host, port = server.address
+        client = MemStoreClient(server.address, redials=2,
+                                redial_backoff=0.0)
+        assert client.push(IDENTITY, 1, b'x') is True
+        server.close()
+        for step in range(2, 6):         # dead-socket push + 2 failed
+            assert client.push(IDENTITY, step, b'y') is False   # redials
+        server = self._rebind(MemStore(), host, port)
+        try:                             # budget spent: stays degraded
+            assert client.push(IDENTITY, 9, b'z') is False
+        finally:
+            client.close()
+            server.close()
+
     def test_sharded_leaf_round_trip_is_bitwise(self):
         """The multi-host wire format: a sharded array serialized as its
         per-shard pieces reassembles bitwise onto the same sharding, and

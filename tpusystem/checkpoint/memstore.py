@@ -42,6 +42,7 @@ import os
 import pickle
 import socket
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any
 
@@ -507,7 +508,17 @@ class MemStoreServer:
 
     def close(self) -> None:
         self._closed.set()
+        # shutdown before close on the LISTENER too: a close() alone
+        # does not unblock the accept thread on Linux, which then holds
+        # the kernel's reference to the listening fd forever — the port
+        # stays bound and a restarted supervisor cannot re-listen at
+        # its own address (found by the bounced-server redial drill)
+        try:
+            self._server.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         self._server.close()
+        self._accept.join(timeout=5.0)
         with self._conns_lock:
             conns, self._conns = self._conns, []
         for sock in conns:
@@ -529,22 +540,85 @@ class MemStoreClient:
     supervisor socket: hot state is an accelerator, never a requirement,
     and a hot-tier-only failure must not take down training that disk
     checkpoints would have carried (``push`` returns False, ``fetch``
-    returns None — both logged once)."""
+    returns None — both logged once).
+
+    A dead socket is not forever: a supervisor that RESTARTS listens at
+    the same address again, and pushes that stopped flowing would leave
+    journal/hot-state durability silently frozen for the rest of the
+    run. So on failure the client drops the socket and **redials** on
+    the next call — bounded (``redials`` attempts per outage, a fresh
+    budget after any success) and backed off (``redial_backoff * 2 **
+    attempt`` capped at ``redial_cap``; calls inside the backoff window
+    just degrade, they never sleep — the caller is the serving/training
+    hot loop). Budget exhausted = the old permanent degradation, logged
+    once."""
 
     def __init__(self, address: tuple[str, int],
-                 chunk_size: int = BLOB_CHUNK) -> None:
-        self._sock = socket.create_connection(tuple(address), timeout=10.0)
-        self._sock.settimeout(None)
+                 chunk_size: int = BLOB_CHUNK, *, redials: int = 8,
+                 redial_backoff: float = 0.5, redial_cap: float = 30.0,
+                 clock: Any = None) -> None:
+        self.address = tuple(address)
+        self.chunk_size = chunk_size
+        self.redials = redials
+        self.redial_backoff = redial_backoff
+        self.redial_cap = redial_cap
+        self._clock = clock or time.monotonic
         self._lock = threading.Lock()
         self._down = False
-        self.chunk_size = chunk_size
+        self._attempts = 0           # redials consumed this outage
+        self._retry_at = 0.0         # earliest next redial (clock units)
+        self._sock: socket.socket | None = socket.create_connection(
+            self.address, timeout=10.0)
+        self._sock.settimeout(None)
 
     def _lost(self, what: str, error: Any) -> None:
+        """Drop the dead socket and arm the redial backoff. Called with
+        ``_lock`` held (every wire method owns the lock around its whole
+        exchange)."""
         if not self._down:      # log the first failure, not every step
             logger.warning('supervisor unreachable during %s (%s); hot '
-                           'state disabled — disk checkpoints still stand',
-                           what, error)
+                           'state degraded — disk checkpoints still stand, '
+                           'redialing with backoff (%d attempts left)',
+                           what, error, max(0, self.redials - self._attempts))
         self._down = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+        backoff = min(self.redial_cap,
+                      self.redial_backoff * 2 ** self._attempts)
+        self._retry_at = self._clock() + backoff
+
+    def _ensure(self) -> bool:
+        """True when a live socket is available — redialing a restarted
+        supervisor when the backoff window has passed and the outage
+        budget allows. Called with ``_lock`` held."""
+        if self._sock is not None:
+            return True
+        if self._attempts >= self.redials:
+            return False             # budget spent: permanently degraded
+        if self._clock() < self._retry_at:
+            return False             # inside the backoff window: degrade
+        self._attempts += 1
+        try:
+            sock = socket.create_connection(self.address, timeout=10.0)
+        except OSError as error:
+            backoff = min(self.redial_cap,
+                          self.redial_backoff * 2 ** self._attempts)
+            self._retry_at = self._clock() + backoff
+            if self._attempts >= self.redials:
+                logger.warning(
+                    'supervisor at %r still unreachable after %d redials '
+                    '(%s); hot state disabled for the rest of this run',
+                    self.address, self._attempts, error)
+            return False
+        sock.settimeout(None)
+        self._sock = sock
+        logger.info('supervisor at %r reachable again after %d redial(s); '
+                    'hot-state pushes resume', self.address, self._attempts)
+        return True
 
     def push(self, identity: str, step: int, state: Any, *,
              extras: Any | None = None) -> bool:
@@ -555,47 +629,69 @@ class MemStoreClient:
         blob = state if isinstance(state, bytes) else serialize_state(state)
         digest = blob_digest(blob)
         total = max(1, -(-len(blob) // self.chunk_size))
-        try:
-            with self._lock:
-                _send_frame(self._sock, ('put', identity, int(step), digest,
-                                         extras, total))
+        with self._lock:
+            if not self._ensure():
+                return False
+            sock = self._sock        # close() may null the attr mid-call;
+            try:                     # the local keeps failures typed OSError
+                _send_frame(sock, ('put', identity, int(step), digest,
+                                   extras, total))
                 for index in range(total):
                     _send_frame(
-                        self._sock,
+                        sock,
                         ('chunk', index,
                          blob[index * self.chunk_size:
                               (index + 1) * self.chunk_size]))
-                reply = _recv_frame(self._sock)
-        except OSError as error:
-            self._lost(f'push of {identity!r} step {step}', error)
-            return False
-        if reply is None or reply[0] != 'ok':
-            self._lost(f'push of {identity!r} step {step}',
-                       reply[1] if reply else 'connection closed')
-            return False
-        self._down = False
+                reply = _recv_frame(sock)
+            except OSError as error:
+                self._lost(f'push of {identity!r} step {step}', error)
+                return False
+            if reply is None:
+                self._lost(f'push of {identity!r} step {step}',
+                           'connection closed')
+                return False
+            if reply[0] != 'ok':     # the store REFUSED (e.g. digest):
+                # the socket is healthy — a rejection is not an outage
+                logger.warning('hot push of %r step %d rejected: %s',
+                               identity, step, reply[1])
+                return False
+            self._down = False
+            self._attempts = 0       # a success refills the redial budget
         return True
 
     def fetch(self, identity: str) -> HotState | None:
         """The supervisor's newest hot state for the identity, or None
         (missing, digest failed, or the supervisor is unreachable —
         either way: fall back to disk)."""
-        try:
-            with self._lock:
-                _send_frame(self._sock, ('get', identity))
-                reply = _recv_frame(self._sock)
-                if reply is None or reply[0] == 'none':
+        with self._lock:
+            if not self._ensure():
+                return None
+            sock = self._sock
+            try:
+                _send_frame(sock, ('get', identity))
+                reply = _recv_frame(sock)
+                if reply is None:
+                    self._lost(f'fetch of {identity!r}',
+                               'connection closed')
+                    return None
+                if reply[0] == 'none':
+                    self._down = False
+                    self._attempts = 0
                     return None
                 _, step, digest, extras, total = reply
                 parts = []
                 for _ in range(total):
-                    chunk = _recv_frame(self._sock)
+                    chunk = _recv_frame(sock)
                     if chunk is None:
+                        self._lost(f'fetch of {identity!r}',
+                                   'stream ended mid-transfer')
                         return None
                     parts.append(chunk[2])
-        except OSError as error:
-            self._lost(f'fetch of {identity!r}', error)
-            return None
+            except OSError as error:
+                self._lost(f'fetch of {identity!r}', error)
+                return None
+            self._down = False
+            self._attempts = 0
         blob = b''.join(parts)
         if blob_digest(blob) != digest:
             logger.warning('fetched hot state for %r step %d failed its '
@@ -608,17 +704,29 @@ class MemStoreClient:
         """Timeline breadcrumb (``restore``, ``first-step``, ``fence``):
         fire-and-forget; the supervisor stamps arrival time and folds it
         into the :class:`~tpusystem.observe.events.RecoveryTimeline`."""
-        try:
-            with self._lock:
+        with self._lock:
+            if not self._ensure():
+                return
+            try:
                 _send_frame(self._sock, ('mark', stage, dict(info)))
-        except OSError:
-            pass     # a dying supervisor must not take the worker with it
+            except (OSError, AttributeError) as error:
+                # a dying supervisor must not take the worker with it
+                # (AttributeError: close() nulled the socket mid-call)
+                self._lost(f'mark {stage!r}', error)
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        # deliberately lock-free: a wire call blocked in recv on a hung
+        # supervisor socket HOLDS the lock — close() must be able to
+        # force the socket shut underneath it (the blocked call then
+        # surfaces OSError and degrades). Spending the redial budget
+        # first keeps a racing _ensure from dialing a fresh socket.
+        self._attempts = self.redials       # closed on purpose: no redial
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 def supervisor_client(env: dict | None = None) -> MemStoreClient | None:

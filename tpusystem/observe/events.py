@@ -206,6 +206,49 @@ class RequestReplayed:
 
 
 @event
+class ReplicaUnhealthy:
+    """The fleet router's health verdict on one replica: its step or
+    submit died (the SIGKILL signature), or its heartbeat went stale.
+    The verdict is one-way — the router never routes there again;
+    ``routed`` is how many in-flight requests must re-home onto the
+    survivors (:mod:`tpusystem.serve.fleet`)."""
+    name: str
+    cause: str
+    routed: int
+
+
+@event
+class RequestRerouted:
+    """The router moved a request to a different replica: ``cause`` is
+    ``'failover'`` (its replica died — journal handoff), ``'timeout'``
+    (it overstayed the per-replica patience ladder) or ``'hedge'`` (a
+    duplicate racing the straggler; first completion wins). ``where`` /
+    ``prefix`` follow ``RequestReplayed``'s convention: a hot move
+    re-prefills ``prefix`` already-emitted tokens on the target engine
+    and resumes; greedy decode keeps the final completion token-exact
+    across the move."""
+    id: str
+    origin: str
+    target: str
+    where: str                       # 'hot' | 'cold'
+    prefix: int
+    cause: str                       # 'failover' | 'timeout' | 'hedge'
+
+
+@event
+class FleetResized:
+    """The traffic-driven autoscaler changed the replica set: sustained
+    backpressure ``'grow'``\\ s it through the provision seam (capacity
+    carved from training via the supervisor/elastic resize path),
+    sustained idleness ``'shrink'``\\ s it back. ``replicas`` is the
+    healthy fleet size AFTER the change."""
+    action: str                      # 'grow' | 'shrink'
+    replicas: int
+    cause: str
+    name: str                        # the replica added / retired
+
+
+@event
 class EngineRestarted:
     """A serving replica rebuilt its engine and replayed its journal —
     ``cause`` is ``'relaunch'`` (a fresh process found a recoverable

@@ -28,12 +28,13 @@ import time
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
                                       Backpressure, ElasticTimeline,
-                                      EngineRestarted, LoadShed,
-                                      RecoveryTimeline, RecsysEvaluated,
-                                      ReplicaDiverged, RequestAdmitted,
-                                      RequestExpired, RolledBack,
-                                      ServeStepped, Trained, Validated,
-                                      WorkerExited, WorldResized)
+                                      EngineRestarted, FleetResized,
+                                      LoadShed, RecoveryTimeline,
+                                      RecsysEvaluated, ReplicaDiverged,
+                                      ReplicaUnhealthy, RequestAdmitted,
+                                      RequestExpired, RequestRerouted,
+                                      RolledBack, ServeStepped, Trained,
+                                      Validated, WorkerExited, WorldResized)
 from tpusystem.services.prodcon import Consumer, Depends
 
 # ---------------------------------------------------------------- crc32c ---
@@ -302,6 +303,41 @@ def tensorboard_consumer() -> Consumer:
         board.add_scalar('serve/backpressure',
                          1.0 if event.engaged else 0.0,
                          backpressure_counts[0])
+
+    # fleet tier: health verdicts, reroutes and resizes have no global
+    # step, so each charts against its own counter — a failover incident
+    # (verdict → N reroutes → maybe a grow) reads straight off the
+    # fleet/* dashboard next to the per-replica serve/* rows
+    unhealthy_counts = [0]
+    reroute_counts = [0]
+    resize_counts = [0]
+
+    @consumer.handler
+    def on_replica_unhealthy(event: ReplicaUnhealthy,
+                             board: SummaryWriter = Depends(writer)) -> None:
+        unhealthy_counts[0] += 1
+        board.add_scalar('fleet/unhealthy_total', float(unhealthy_counts[0]),
+                         unhealthy_counts[0])
+        board.add_scalar('fleet/rehomed_requests', float(event.routed),
+                         unhealthy_counts[0])
+
+    @consumer.handler
+    def on_request_rerouted(event: RequestRerouted,
+                            board: SummaryWriter = Depends(writer)) -> None:
+        reroute_counts[0] += 1
+        board.add_scalar('fleet/rerouted_total', float(reroute_counts[0]),
+                         reroute_counts[0])
+        # per reroute: how much already-emitted work the hot handoff
+        # carried over (0 = a cold re-submit re-decodes everything)
+        board.add_scalar('fleet/reroute_prefix', float(event.prefix),
+                         reroute_counts[0])
+
+    @consumer.handler
+    def on_fleet_resized(event: FleetResized,
+                         board: SummaryWriter = Depends(writer)) -> None:
+        resize_counts[0] += 1
+        board.add_scalar('fleet/replicas', float(event.replicas),
+                         resize_counts[0])
 
     @consumer.handler
     def on_recovery(event: RecoveryTimeline,

@@ -302,10 +302,14 @@ class PreemptionWave:
 
     ``kills`` are callables fired in order (``transport.kill`` for a
     control-plane-only death, ``os.kill`` of a worker for the real
-    thing); ``stagger`` seconds between them models losses spread inside
-    a wave — pick it below the coordinator's settle window to assert the
-    one-resize contract, above it to drill the two-epoch case. Same
-    fired-once discipline as :class:`DieAtStep`::
+    thing, a fleet handle's
+    :meth:`~tpusystem.serve.ReplicaHandle.kill` for the serving-fleet
+    drill — the router tick is the ``step``); ``stagger`` seconds
+    between them models losses spread inside a wave — pick it below the
+    coordinator's settle window to assert the one-resize contract,
+    above it to drill the two-epoch case (``sleep`` is injectable so a
+    fake-clock drill staggers without real waits). Same fired-once
+    discipline as :class:`DieAtStep`::
 
         wave = PreemptionWave(step=5, kills=(t2.kill, t3.kill))
         for batch in loader:
@@ -316,6 +320,7 @@ class PreemptionWave:
     step: int
     kills: tuple = ()
     stagger: float = 0.0
+    sleep: Callable[[float], None] = time.sleep
     fired: bool = field(default=False, init=False)
 
     def __call__(self, current_step: int) -> None:
@@ -324,7 +329,7 @@ class PreemptionWave:
         self.fired = True
         for index, kill in enumerate(self.kills):
             if index and self.stagger:
-                time.sleep(self.stagger)
+                self.sleep(self.stagger)
             kill()
 
 

@@ -44,7 +44,13 @@ three pieces, all riding machinery the repo already has:
 Every transition is a domain event (``WorldResizeProposed`` /
 ``WorldResized`` / ``ElasticTimeline``) so the ledger orders a
 preemption-wave incident and TensorBoard charts world size and resize
-latency with zero trainer code. The chaos drill is the contract
+latency with zero trainer code. The serving fleet's traffic-driven
+autoscaler (:mod:`tpusystem.serve.fleet`) is a second client of this
+resize seam: its ``provision``/``release`` callables carve a serving
+replica's capacity out of the training world (and give it back on ebb)
+through exactly this membership protocol plus
+:meth:`~tpusystem.parallel.supervisor.Supervisor.resize` — one fleet,
+traffic-shaped. The chaos drill is the contract
 (``tests/test_elastic.py``): kill k of n hosts mid-run → ONE resize →
 training continues at n−k with state bitwise-equivalent to restoring the
 same step from disk onto the shrunk mesh → a returning host grows the

@@ -906,10 +906,14 @@ class TcpTransport:
     # blob plane: chunked, digest-verified point-to-point byte transfers.
     # The control plane's collectives and events carry small host values;
     # blobs carry the occasional BIG one — a serialized hot TrainState
-    # replica shipped between supervisors (tpusystem.parallel.supervisor).
-    # Bounded frames (BLOB_CHUNK) keep heartbeats and collective traffic
-    # interleaving with a transfer; the whole-blob digest makes any lost,
-    # truncated, or reordered-into-oblivion chunk a *detected* failure.
+    # replica shipped between supervisors (tpusystem.parallel.supervisor),
+    # or a serving replica's request journal ('journal:{identity}' —
+    # tpusystem.serve.failover) that the fleet router pulls back through
+    # the buddy chain to re-home a dead replica's rows onto survivors
+    # (tpusystem.serve.fleet). Bounded frames (BLOB_CHUNK) keep
+    # heartbeats and collective traffic interleaving with a transfer; the
+    # whole-blob digest makes any lost, truncated, or
+    # reordered-into-oblivion chunk a *detected* failure.
 
     def send_blob(self, to: int, key: str, data: bytes,
                   chunk_size: int | None = None) -> None:

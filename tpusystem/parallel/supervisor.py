@@ -80,8 +80,9 @@ logger = logging.getLogger('tpusystem.supervisor')
 
 __all__ = ['Supervisor']
 
-_CODE_NAMES = {0: 'completed', 42: 'worker-lost', 43: 'preempted',
-               44: 'diverged', RESIZED_EXIT: 'resized'}
+_CODE_NAMES = {0: 'completed', FAILURE_EXIT: 'failure', 42: 'worker-lost',
+               43: 'preempted', 44: 'diverged', CRASH_LOOP_EXIT: 'crash-loop',
+               RESIZED_EXIT: 'resized'}
 
 # signal deaths relaunch (a SIGKILLed worker IS the worker-lost case) —
 # EXCEPT these: SIGINT (^C) and SIGQUIT (^\) are *operator intent*, a
@@ -217,7 +218,13 @@ class Supervisor:
     # (tpusystem.serve.failover): its pushes replicate to the buddy and a
     # replaced host's fetch pulls it back exactly like hot training state
     # — the identity prefix keeps journal slots from ever colliding with
-    # the same run's TrainState slots.
+    # the same run's TrainState slots. The fleet router's failover
+    # (tpusystem.serve.fleet) is a THIRD reader of the same keys: when a
+    # serving replica dies for good, the router's recovery chain asks
+    # the dead host's supervisor RAM first and then the buddy for
+    # 'hot:journal:{identity}' — a DIFFERENT surviving replica then
+    # adopts the rows, so no new key kind and no new wire flow is needed
+    # for fleet-level handoff.
 
     def _replicate(self, identity: str, entry: Any) -> None:
         """Queue a verified push for cross-host replication.

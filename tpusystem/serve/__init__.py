@@ -24,7 +24,13 @@ Layers, bottom up:
   request log, token-prefix replay, step watchdog, and watermark load
   shedding that let a replica survive kill, hang, and overload
   (:class:`ServingReplica` is the supervised loop; docs/serving.md
-  "Surviving engine failure").
+  "Surviving engine failure");
+* the fleet tier (:mod:`tpusystem.serve.fleet`) — a health-checked
+  :class:`Router` over N replicas: least-loaded routing with timeout /
+  retry / hedging, journal handoff onto the survivors when a replica
+  dies, fleet-scope watermark shedding with brownout, and
+  traffic-driven autoscale through the supervisor/elastic resize seam
+  (docs/serving.md "A fleet of replicas").
 """
 
 from tpusystem.serve.engine import (Admission, Engine, Saturated,
@@ -35,6 +41,10 @@ from tpusystem.serve.failover import (EngineStalled, JournalCorrupt,
                                       ServingReplica, StepWatchdog,
                                       Watermarks, journal_identity,
                                       recover_journal, replay)
+from tpusystem.serve.fleet import (AutoscalePolicy, FleetSaturated,
+                                   FleetTick, NoHealthyReplica,
+                                   ReplicaDead, ReplicaHandle, RoutePolicy,
+                                   Router)
 from tpusystem.serve.kvcache import (TRASH_BLOCK, PagedKVCache,
                                      adopt_prefill, write_tables)
 from tpusystem.serve.scheduler import (Completion, QueueFull, Request,
@@ -48,4 +58,6 @@ __all__ = ['Engine', 'Admission', 'StepReport', 'Saturated',
            'QueueFull', 'InferenceService',
            'EngineStalled', 'JournalCorrupt', 'RequestJournal',
            'ReplayReport', 'ServingReplica', 'StepWatchdog', 'Watermarks',
-           'journal_identity', 'recover_journal', 'replay']
+           'journal_identity', 'recover_journal', 'replay',
+           'Router', 'ReplicaHandle', 'RoutePolicy', 'AutoscalePolicy',
+           'FleetTick', 'ReplicaDead', 'NoHealthyReplica', 'FleetSaturated']

@@ -262,6 +262,11 @@ class RequestJournal:
             ok, why = False, str(error)
         if ok:
             self.pushes += 1
+            if self._push_failed:    # the store healed (e.g. the client
+                # redialed a restarted supervisor): say so once, so an
+                # incident's log shows WHERE the durability window closed
+                logger.info('journal replication for %r recovered at tick '
+                            '%d', self.identity, self.tick)
             self._push_failed = False
         else:
             if not self._push_failed:

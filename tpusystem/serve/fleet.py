@@ -1,0 +1,855 @@
+"""Serving fleet failover: a health-checked router over N replicas.
+
+PR 12 gave ONE replica a survival story — journaled requests, token-
+prefix replay, a supervised relaunch (:mod:`tpusystem.serve.failover`).
+This module is the tier above it: the thing that turns a surviving
+*replica* into a surviving *service* (ROADMAP item 2, the vLLM/DistServe
+router-over-replicas split). A :class:`Router` fronts N
+:class:`~tpusystem.serve.ServingReplica`\\ s and owns four fleet-level
+robustness moves:
+
+* **Health-checked routing** — every replica carries a router-side
+  verdict (:class:`ReplicaHandle`): healthy replicas take traffic by
+  least load, a replica whose step or submit dies (the in-process
+  signature of SIGKILL — :exc:`ReplicaDead` /
+  :class:`~tpusystem.parallel.chaos.WorkerKilled` / ``OSError``) or
+  whose heartbeat goes stale (externally-driven handles,
+  :meth:`ReplicaHandle.beat`) is marked unhealthy, narrated as a
+  ``ReplicaUnhealthy`` event, and **never routed to again** — the
+  verdict is one-way; a replaced replica joins as a fresh handle
+  (:meth:`Router.adopt`). Queue-depth and the scheduler's
+  ``Backpressure`` flag feed the same placement decision: a
+  backpressured replica is passed over whenever a calmer one exists.
+* **Journal handoff** (the headline): on a replica's death the router
+  recovers its :class:`~tpusystem.serve.RequestJournal` through the
+  existing :func:`~tpusystem.serve.recover_journal` preference chain —
+  the dead replica's supervisor RAM first, then the buddy's
+  ``journal:{identity}`` replica slot over the blob plane — and
+  **redistributes** the rows across the surviving replicas:
+  seated rows re-prefill ``prompt + emitted prefix`` on a *different
+  engine* and resume decode (hot handoff), queued-only rows re-submit
+  cold. Greedy decode is deterministic, so the final completions are
+  token-exact against an uninterrupted fleet — drilled by
+  ``tests/test_serve_fleet.py`` with a
+  :class:`~tpusystem.parallel.chaos.PreemptionWave` killing replicas
+  mid-stream. Rows routed after the journal's last push (the cadence
+  window) are re-submitted cold from the router's own routing table, so
+  **no request is ever silently dropped**, journal or not.
+* **Timeout, retry, hedging** — :class:`RoutePolicy` bounds every
+  request's time on one replica: past ``timeout * retry_backoff **
+  attempt`` the request is cancelled there and re-routed (its partial
+  tokens carry over as a hot prefix; ``max_retries`` caps the ladder),
+  and an optional ``hedge_after`` fires a duplicate on a second replica
+  — first completion wins, the loser is cancelled. Both reroute paths
+  thread the ORIGINAL submission time through
+  :meth:`~tpusystem.serve.Scheduler.restore`'s ``waited=``, so TTFT and
+  latency accounting never reset on a retry. Hedging is safe because
+  decode is greedy; sampled decode would race two different answers
+  (docs/serving.md records the caveat).
+* **Fleet degradation + autoscale** — fleet-scope
+  :class:`~tpusystem.serve.Watermarks` shed by deadline slack across
+  the WHOLE fleet's queues (the globally most-doomed request goes
+  first), and past the high mark the fleet **browns out**: new requests
+  without a deadline are refused typed (:exc:`FleetSaturated`) at the
+  front door before the backlog collapses into shedding everything.
+  Sustained backpressure grows the replica set and sustained idleness
+  shrinks it (:class:`AutoscalePolicy` + ``provision``/``release``
+  callables — the :meth:`~tpusystem.parallel.Supervisor.resize` /
+  elastic-membership seam that carves chips from training and gives
+  them back), narrated as ``FleetResized`` with ``fleet/*`` TensorBoard
+  charts.
+
+Everything runs on ONE injectable ``clock`` shared with every replica
+and scheduler (the failover discipline), so timeout/hedge/shed/autoscale
+policy is tier-1-testable with zero real sleeps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable
+
+from tpusystem.parallel.chaos import WorkerKilled
+from tpusystem.serve.failover import Watermarks, recover_journal
+from tpusystem.serve.scheduler import QueueFull
+from tpusystem.serve.engine import Saturated
+
+logger = logging.getLogger('tpusystem.serve.fleet')
+
+__all__ = ['ReplicaDead', 'NoHealthyReplica', 'FleetSaturated',
+           'RoutePolicy', 'AutoscalePolicy', 'ReplicaHandle', 'FleetTick',
+           'Router']
+
+
+class ReplicaDead(RuntimeError):
+    """The replica behind a handle is gone — raised by the handle's own
+    kill seam (the in-process stand-in for SIGKILL) and treated, like
+    :class:`~tpusystem.parallel.chaos.WorkerKilled` and ``OSError``,
+    as a health verdict by the router: recover the journal, redistribute
+    the rows, never route there again."""
+
+
+class NoHealthyReplica(RuntimeError):
+    """Every replica in the fleet is unhealthy — nothing can take the
+    request right now. Submissions raise it; rows recovered from a dead
+    replica's journal are parked in the router's orphan buffer instead
+    (placed the moment a replica is adopted), so recovery itself never
+    loses work to a momentary zero-healthy window."""
+
+
+class FleetSaturated(RuntimeError):
+    """The fleet refused the request at the front door: every healthy
+    replica's backlog is full (``QueueFull`` everywhere), or the fleet
+    is in brownout (global queue past the high watermark) and the
+    request carries no deadline — unbounded-patience work is the first
+    thing a degrading fleet stops accepting, BEFORE the backlog
+    collapses into shedding requests that could still meet their
+    deadlines."""
+
+
+# the exception classes the router reads as "this replica is dead", as
+# opposed to a routing signal (QueueFull/Saturated) or a caller error
+# (ValueError): the handle's own kill seam, the chaos harness's worker
+# death, and the socket deaths a remote-replica transport would surface
+_DEAD = (ReplicaDead, WorkerKilled, ConnectionError, OSError)
+
+
+@dataclasses.dataclass(frozen=True)
+class RoutePolicy:
+    """Per-request placement policy.
+
+    ``timeout`` bounds a request's time on one replica: past
+    ``timeout * retry_backoff ** attempt`` it is cancelled there and
+    re-routed to another healthy replica with its partial tokens as a
+    hot prefix — capped exponential patience, at most ``max_retries``
+    reroutes (after that the request stays put and its own ``deadline``
+    is the last word). ``hedge_after`` (None = off) duplicates a
+    still-unfinished request onto a second replica after that many
+    seconds; the first completion wins and the loser is cancelled.
+    """
+
+    timeout: float | None = None
+    max_retries: int = 2
+    retry_backoff: float = 2.0
+    hedge_after: float | None = None
+
+    def __post_init__(self) -> None:
+        if self.timeout is not None and self.timeout <= 0:
+            raise ValueError(f'timeout must be positive seconds, got '
+                             f'{self.timeout!r}')
+        if self.max_retries < 0 or self.retry_backoff < 1.0:
+            raise ValueError(
+                f'need max_retries >= 0 and retry_backoff >= 1.0, got '
+                f'{self.max_retries}/{self.retry_backoff}')
+        if self.hedge_after is not None and self.hedge_after <= 0:
+            raise ValueError(f'hedge_after must be positive seconds, got '
+                             f'{self.hedge_after!r}')
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    """Traffic-driven fleet sizing.
+
+    ``grow_after`` consecutive backpressured router ticks add a replica
+    (up to ``max_replicas``) through the ``provision`` callable;
+    ``shrink_after`` consecutive fully-idle ticks retire the emptiest
+    one (down to ``min_replicas``) through ``release``. ``cooldown``
+    ticks must pass between resizes so one burst cannot thrash the
+    resize seam — the same rate-limit discipline as the elastic
+    coordinator's cooldown.
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 8
+    grow_after: int = 3
+    shrink_after: int = 50
+    cooldown: int = 10
+
+    def __post_init__(self) -> None:
+        if not (1 <= self.min_replicas <= self.max_replicas):
+            raise ValueError(
+                f'need 1 <= min_replicas <= max_replicas, got '
+                f'{self.min_replicas}/{self.max_replicas}')
+        if self.grow_after < 1 or self.shrink_after < 1 or self.cooldown < 0:
+            raise ValueError('grow_after/shrink_after must be >= 1 ticks '
+                             'and cooldown >= 0')
+
+
+class ReplicaHandle:
+    """The router's view of one replica: placement counters, the health
+    verdict, and the journal recovery chain.
+
+    ``replica`` is a :class:`~tpusystem.serve.ServingReplica` or any
+    object with its surface (``submit``/``step``/``results``/``idle``
+    plus a ``scheduler``) — the fleet policy tests drive fakes through
+    the same seam. ``journal_clients`` is the recovery preference chain
+    for THIS replica's journal (dead replica's supervisor RAM first,
+    then the buddy's replica slot — exactly
+    :func:`~tpusystem.serve.recover_journal`'s contract); it defaults
+    to the replica's own ``client`` + ``fallbacks``.
+
+    ``external=True`` marks a replica the router must NOT step — it is
+    driven by its own thread or process and proves liveness by calling
+    :meth:`beat`; the router's ``heartbeat_timeout`` turns a stale beat
+    into the unhealthy verdict (the remote-fleet liveness signal,
+    mirrored in-process).
+
+    :meth:`kill` is the chaos seam: the in-process analogue of SIGKILL
+    (every later touch raises :exc:`ReplicaDead`), while the journal's
+    out-of-process store — the supervisor RAM a real kill leaves behind
+    — survives in ``journal_clients``.
+    """
+
+    def __init__(self, replica: Any, *, name: str | None = None,
+                 journal_clients: tuple = (), external: bool = False) -> None:
+        self.replica = replica
+        self.identity = getattr(replica, 'identity', None) or name or 'serve'
+        self.name = name or self.identity
+        if journal_clients:
+            self.journal_clients = tuple(journal_clients)
+        else:
+            self.journal_clients = (getattr(replica, 'client', None),
+                                    *getattr(replica, 'fallbacks', ()))
+        self.external = external
+        self.healthy = True
+        self.cause: str | None = None
+        self.placements = 0          # submits + restores routed here
+        self.last_beat: float | None = None
+        self._beat_pending = False
+        self._killed = False
+
+    # ------------------------------------------------------------ state
+
+    @property
+    def scheduler(self) -> Any:
+        return getattr(self.replica, 'scheduler', self.replica)
+
+    @property
+    def queue_depth(self) -> int:
+        return self.scheduler.queue_depth
+
+    @property
+    def depth(self) -> int:
+        """Load metric for least-loaded placement: queued + seated."""
+        return self.scheduler.queue_depth + self.scheduler.active
+
+    @property
+    def backpressure(self) -> bool:
+        return bool(getattr(self.scheduler, 'backpressure', False))
+
+    @property
+    def idle(self) -> bool:
+        return bool(self.replica.idle)
+
+    @property
+    def results(self) -> dict:
+        return self.replica.results
+
+    # ------------------------------------------------------------ seams
+
+    def kill(self) -> None:
+        """Chaos seam: abrupt replica death (``PreemptionWave(kills=
+        (handle.kill,))``). Every subsequent touch raises
+        :exc:`ReplicaDead`; the journal stores outlive it."""
+        self._killed = True
+
+    def beat(self) -> None:
+        """Externally-driven replicas call this from their own loop; the
+        router stamps it with ITS clock at the next health check (the
+        replica's thread must not race the router's time base) and
+        ``heartbeat_timeout`` judges staleness."""
+        self._beat_pending = True
+
+    def _check(self) -> None:
+        if self._killed:
+            raise ReplicaDead(f'replica {self.name!r} was killed')
+
+    def submit(self, request: Any) -> None:
+        self._check()
+        self.replica.submit(request)
+        self.placements += 1
+
+    def restore(self, request: Any, *, waited: float, prefix=()) -> None:
+        """Place a rerouted/recovered row here: the scheduler re-queues
+        it with its original wait and emitted prefix (and its journal —
+        ``scheduler.journal`` — witnesses the restore, so a later death
+        of THIS replica hands the row on again)."""
+        self._check()
+        self.scheduler.restore(request, waited=waited, prefix=prefix)
+        self.placements += 1
+
+    def cancel(self, request_id: str) -> str | None:
+        if self._killed or not self.healthy:
+            return None
+        try:
+            return self.scheduler.cancel(request_id)
+        except _DEAD:
+            return None
+
+    def step(self) -> Any:
+        self._check()
+        return self.replica.step()
+
+
+@dataclasses.dataclass
+class _Route:
+    """The router's own record of where a request lives — the authority
+    that guarantees no-silent-drop even past the journal's cadence
+    window, and the source of the ORIGINAL submission time every
+    reroute's ``waited=`` is computed from."""
+
+    request: Any
+    handle: str                      # current primary placement
+    submitted: float                 # original router-clock submission
+    routed_at: float                 # last (re)placement
+    attempt: int = 0                 # reroutes consumed (timeout ladder)
+    hedged: str | None = None        # secondary placement, when hedged
+
+
+@dataclasses.dataclass
+class FleetTick:
+    """One router step's outcome, fleet-wide."""
+
+    replicas: int                    # handles still healthy
+    queued: int                      # global queue depth (healthy replicas)
+    active: int
+    completed: list                  # request ids settled this tick
+    rerouted: list                   # RequestRerouted narrations this tick
+    shed: list                       # fleet-watermark victims this tick
+    orphans: int                     # recovered rows awaiting a replica
+    emitted: dict = dataclasses.field(default_factory=dict)
+    # request id -> token, merged across the replicas' ticks — what the
+    # fleet delivered this step (the recovery bench watches it for the
+    # first post-handoff token)
+
+
+class Router:
+    """The fleet front door: health-checked, least-loaded, journal-aware.
+
+    Args:
+        handles: the initial fleet — :class:`ReplicaHandle` instances
+            (bare ``ServingReplica``\\ s are wrapped automatically).
+        policy: per-request :class:`RoutePolicy` (timeout/retry/hedge).
+        watermarks: fleet-scope :class:`~tpusystem.serve.Watermarks`
+            over the GLOBAL queue depth — shed by deadline slack across
+            every replica's queue, brownout past the high mark.
+        heartbeat_timeout: seconds after which an ``external`` handle's
+            stale :meth:`~ReplicaHandle.beat` reads as death (None =
+            externally-driven replicas are never judged by heartbeat).
+        autoscale / provision / release: :class:`AutoscalePolicy` plus
+            the resize seam — ``provision() -> ReplicaHandle`` grows
+            the fleet (a supervised replica on capacity carved from
+            training: :meth:`tpusystem.parallel.Supervisor.resize` /
+            the elastic membership protocol), ``release(handle)``
+            gives an idle replica's chips back.
+        producer: event bus for ``ReplicaUnhealthy`` /
+            ``RequestRerouted`` / ``FleetResized`` + the fleet-scope
+            ``LoadShed``/``Backpressure`` narration.
+        clock: THE fleet clock — must be the same callable every
+            replica and scheduler in the fleet runs on (enforced per
+            replica by ``ServingReplica``; timeouts, hedging, shedding
+            and waited-accounting all subtract its timestamps).
+    """
+
+    def __init__(self, handles, *, policy: RoutePolicy | None = None,
+                 watermarks: Watermarks | None = None,
+                 heartbeat_timeout: float | None = None,
+                 autoscale: AutoscalePolicy | None = None,
+                 provision: Callable[[], ReplicaHandle] | None = None,
+                 release: Callable[[ReplicaHandle], None] | None = None,
+                 producer: Any = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.handles = [handle if isinstance(handle, ReplicaHandle)
+                        else ReplicaHandle(handle) for handle in handles]
+        names = [handle.name for handle in self.handles]
+        if len(set(names)) != len(names):
+            raise ValueError(f'replica names must be unique, got {names}')
+        self.policy = policy or RoutePolicy()
+        self.watermarks = watermarks
+        self.heartbeat_timeout = heartbeat_timeout
+        self.autoscale = autoscale
+        if autoscale is not None and provision is None:
+            raise ValueError('autoscale needs a provision() callable — the '
+                             'supervisor/elastic resize seam that builds a '
+                             'new replica')
+        self._provision = provision
+        self._release = release
+        self.producer = producer
+        self._clock = clock
+        self.results: dict[str, Any] = {}
+        self.brownout = False
+        self.ticks = 0
+        self._routes: dict[str, _Route] = {}
+        self._orphans: list = []     # (request, submitted_at, prefix) rows
+        self._reroutes_pending: list = []   # drained into the next FleetTick
+        self._pressure_ticks = 0
+        self._idle_ticks = 0
+        self._cooldown = 0
+        for handle in self.handles:
+            handle.last_beat = self._clock()
+
+    # ------------------------------------------------------------ intake
+
+    @property
+    def healthy(self) -> list[ReplicaHandle]:
+        return [handle for handle in self.handles if handle.healthy]
+
+    def _by_name(self, name: str) -> ReplicaHandle | None:
+        for handle in self.handles:
+            if handle.name == name:
+                return handle
+        return None
+
+    def _targets(self, *, exclude: str | None = None) -> list[ReplicaHandle]:
+        """Healthy replicas in placement order: calm before
+        backpressured, least-loaded first, fleet order as the stable
+        tie-break."""
+        ranked = [handle for handle in self.healthy
+                  if handle.name != exclude]
+        return sorted(ranked,
+                      key=lambda handle: (handle.backpressure, handle.depth))
+
+    def submit(self, request: Any) -> str:
+        """Route a request to the best healthy replica; returns the
+        replica name it landed on. Raises :exc:`NoHealthyReplica` when
+        the fleet is empty/dead and :exc:`FleetSaturated` when every
+        healthy backlog is full — or when the fleet is in brownout and
+        the request carries no deadline (degrade at the front door
+        before the backlog collapses)."""
+        if self.brownout and getattr(request, 'deadline', None) is None:
+            raise FleetSaturated(
+                f'request {request.id!r} refused: the fleet is past its '
+                f'high watermark and the request has no deadline — '
+                f'brownout sheds unbounded-patience work at the front door')
+        now = self._clock()
+        targets = self._targets()
+        if not targets:
+            raise NoHealthyReplica('no healthy replica in the fleet')
+        full = 0
+        for handle in targets:
+            try:
+                handle.submit(request)
+            except (QueueFull, Saturated):
+                full += 1
+                continue
+            except _DEAD as death:
+                self._fail(handle, f'died at submit ({death})')
+                continue
+            self._routes[request.id] = _Route(request, handle.name, now, now)
+            return handle.name
+        if full:
+            raise FleetSaturated(
+                f'request {request.id!r} refused: every healthy replica '
+                f'is at max_queued')
+        raise NoHealthyReplica('every replica died during placement')
+
+    def cancel(self, request_id: str) -> str | None:
+        """Cancel a request wherever the fleet holds it (both legs of a
+        hedge, AND the orphan buffer — a cancelled row must not be
+        resurrected by the next adopt); returns the primary leg's
+        verdict (orphans count as ``'queued'``: silently dropped, the
+        scheduler's queued-cancel contract)."""
+        route = self._routes.pop(request_id, None)
+        orphaned = [entry for entry in self._orphans
+                    if entry[0].id == request_id]
+        for entry in orphaned:
+            self._orphans.remove(entry)
+        if route is None:
+            return 'queued' if orphaned else None
+        where = 'queued' if orphaned else None
+        for name in (route.handle, route.hedged):
+            if name is None:
+                continue
+            handle = self._by_name(name)
+            if handle is None:
+                continue
+            verdict = handle.cancel(request_id)
+            if name == route.handle:
+                where = verdict if verdict is not None else where
+                completion = handle.scheduler.results.get(request_id)
+                if completion is not None:
+                    self.results[request_id] = completion
+        return where
+
+    # ------------------------------------------------------------ health
+
+    def _dispatch(self, event: Any) -> None:
+        if self.producer is not None:
+            self.producer.dispatch(event)
+
+    def _fail(self, handle: ReplicaHandle, cause: str) -> None:
+        """The health verdict: mark the replica unhealthy (one-way),
+        recover its journal through the preference chain, and hand its
+        rows to the survivors — hot for seated rows, cold for queued
+        ones and for anything only the router's own table remembers."""
+        if not handle.healthy:
+            return
+        handle.healthy = False
+        handle.cause = cause
+        in_flight = [route for route in self._routes.values()
+                     if handle.name in (route.handle, route.hedged)]
+        logger.warning(
+            'replica %r marked unhealthy (%s); recovering its journal and '
+            're-homing %d in-flight requests', handle.name, cause,
+            len(in_flight))
+        from tpusystem.observe.events import ReplicaUnhealthy
+        self._dispatch(ReplicaUnhealthy(name=handle.name, cause=cause,
+                                        routed=len(in_flight)))
+        recovered = recover_journal(handle.identity, handle.journal_clients)
+        rows = recovered[1] if recovered is not None else []
+        if recovered is None:
+            logger.warning(
+                'no recoverable journal for %r; its rows re-home cold from '
+                'the routing table alone', handle.name)
+        handled: set[str] = set()
+        for request, waited, emitted in rows:
+            handled.add(request.id)
+            route = self._routes.get(request.id)
+            if request.id in self.results:
+                continue             # already settled (hedge won elsewhere)
+            if route is not None:
+                if route.hedged == handle.name:
+                    route.hedged = None       # dead hedge leg: primary lives
+                    continue
+                if (route.handle != handle.name
+                        and self._is_healthy(route.handle)):
+                    continue         # live elsewhere (rerouted earlier)
+                # prefer the router's own clock over the journal's
+                # packed waited-seconds: the journal cannot count the
+                # outage between its last push and this recovery
+                waited = self._clock() - route.submitted
+            self._place(request, waited, list(emitted), origin=handle.name,
+                        cause='failover', route=route)
+        # the cadence window: rows routed after the journal's last push
+        # exist only in the routing table — cold re-submit, never drop
+        for route in in_flight:
+            request = route.request
+            if request.id in handled or request.id in self.results:
+                continue
+            if route.hedged == handle.name:
+                route.hedged = None
+                continue
+            if route.handle != handle.name and self._is_healthy(route.handle):
+                continue
+            self._place(request, self._clock() - route.submitted, [],
+                        origin=handle.name, cause='failover', route=route)
+
+    def _is_healthy(self, name: str) -> bool:
+        handle = self._by_name(name)
+        return handle is not None and handle.healthy
+
+    def _place(self, request, waited: float, emitted: list, *, origin: str,
+               cause: str, route: _Route | None) -> None:
+        """Re-home one row on the best survivor (or the orphan buffer
+        when none is healthy), narrated as ``RequestRerouted``."""
+        now = self._clock()
+        targets = self._targets(exclude=origin)
+        placed = None
+        for handle in targets:
+            try:
+                handle.restore(request, waited=waited, prefix=emitted)
+            except _DEAD as death:
+                self._fail(handle, f'died at restore ({death})')
+                continue
+            except ValueError:
+                # a finished row has no business being re-homed (the
+                # journal copy predates its completion): settle nothing,
+                # the completion already stands where it was delivered
+                return
+            placed = handle
+            break
+        if placed is None:
+            self._orphans.append((request, now - waited, list(emitted)))
+            logger.warning('no healthy replica can adopt %r; parked in the '
+                           'orphan buffer', request.id)
+            return
+        if route is None:
+            route = self._routes[request.id] = _Route(
+                request, placed.name, now - waited, now)
+        route.handle, route.routed_at = placed.name, now
+        from tpusystem.observe.events import RequestRerouted
+        narration = RequestRerouted(
+            id=request.id, origin=origin, target=placed.name,
+            where='hot' if emitted else 'cold', prefix=len(emitted),
+            cause=cause)
+        self._reroutes_pending.append(narration)
+        self._dispatch(narration)
+
+    def adopt(self, handle: ReplicaHandle | Any) -> ReplicaHandle:
+        """Add a replica to the fleet (a provisioned grow, or a replaced
+        host rejoining as a FRESH handle — verdicts are one-way) and
+        drain any orphaned rows onto it."""
+        if not isinstance(handle, ReplicaHandle):
+            handle = ReplicaHandle(handle)
+        if self._by_name(handle.name) is not None:
+            raise ValueError(f'replica name {handle.name!r} already in the '
+                             f'fleet — retire the old handle first')
+        handle.last_beat = self._clock()
+        self.handles.append(handle)
+        orphans, self._orphans = self._orphans, []
+        for request, submitted_at, emitted in orphans:
+            self._place(request, self._clock() - submitted_at, emitted,
+                        origin='orphans', cause='failover',
+                        route=self._routes.get(request.id))
+        return handle
+
+    # ------------------------------------------------------------ serving
+
+    def step(self) -> FleetTick:
+        """One fleet tick: step every healthy replica, settle
+        completions (first wins under hedging), judge heartbeats, run
+        the timeout/hedge ladder, shed past the fleet watermark, and
+        let the autoscaler breathe."""
+        self.ticks += 1
+        now = self._clock()
+        completed: list = []
+        emitted: dict = {}
+        for handle in list(self.handles):
+            if not handle.healthy:
+                continue
+            if handle.external:
+                # an external replica is stepped by its own thread — the
+                # router never sees its Ticks, so settle its routed
+                # requests from the results dict instead (the scheduler
+                # records every terminal transition there)
+                self._judge_heartbeat(handle, now)
+                if handle.healthy:
+                    self._harvest_external(handle, completed)
+                continue
+            try:
+                tick = handle.step()
+            except _DEAD as death:
+                self._fail(handle, f'died mid-step ({death})')
+                continue
+            handle.last_beat = self._clock()
+            if tick is None:         # the replica relaunched in-process
+                continue
+            emitted.update(tick.emitted)
+            for completion in tick.completed:
+                self._settle(completion, handle, completed)
+            for completion, _where in tick.expired:
+                self._settle(completion, handle, completed)
+            for completion, _slack in tick.shed:
+                self._settle(completion, handle, completed)
+        self._retry_and_hedge()
+        shed = self._fleet_shed()
+        self._breathe()
+        reroutes, self._reroutes_pending = self._reroutes_pending, []
+        queued = sum(h.scheduler.queue_depth for h in self.healthy)
+        active = sum(h.scheduler.active for h in self.healthy)
+        return FleetTick(replicas=len(self.healthy), queued=queued,
+                         active=active, completed=completed,
+                         rerouted=reroutes, shed=shed,
+                         orphans=len(self._orphans), emitted=emitted)
+
+    def _harvest_external(self, handle: ReplicaHandle,
+                          completed: list) -> None:
+        """Settle routed requests an externally-driven replica finished
+        on its own loop. A route the router itself cancelled is already
+        popped before the cancel lands, so anything still routed here
+        with a terminal result is a genuine completion."""
+        for route in list(self._routes.values()):
+            if handle.name not in (route.handle, route.hedged):
+                continue
+            completion = handle.results.get(route.request.id)
+            if completion is not None:
+                self._settle(completion, handle, completed)
+
+    def _judge_heartbeat(self, handle: ReplicaHandle, now: float) -> None:
+        if getattr(handle, '_beat_pending', False):
+            handle._beat_pending = False
+            handle.last_beat = now
+        if (self.heartbeat_timeout is not None
+                and handle.last_beat is not None
+                and now - handle.last_beat >= self.heartbeat_timeout):
+            self._fail(handle, f'heartbeat stale ({self.heartbeat_timeout}s)')
+
+    def _settle(self, completion: Any, handle: ReplicaHandle,
+                completed: list) -> None:
+        """First terminal verdict wins: record the completion, drop the
+        route, and cancel the losing hedge leg."""
+        request_id = completion.request.id
+        if request_id in self.results:
+            return                   # a hedge already won elsewhere
+        self.results[request_id] = completion
+        completed.append(request_id)
+        route = self._routes.pop(request_id, None)
+        if route is None:
+            return
+        for name in (route.handle, route.hedged):
+            if name is not None and name != handle.name:
+                loser = self._by_name(name)
+                if loser is not None:
+                    loser.cancel(request_id)
+
+    def _retry_and_hedge(self) -> None:
+        if self.policy.timeout is None and self.policy.hedge_after is None:
+            return
+        now = self._clock()
+        for route in list(self._routes.values()):
+            if route.request.id in self.results:
+                continue
+            elapsed = now - route.routed_at
+            if (self.policy.timeout is not None
+                    and route.attempt < self.policy.max_retries
+                    and elapsed >= self.policy.timeout
+                    * self.policy.retry_backoff ** route.attempt):
+                self._reroute_timeout(route)
+                continue
+            if (self.policy.hedge_after is not None and route.hedged is None
+                    and elapsed >= self.policy.hedge_after):
+                self._hedge(route)
+
+    def _reroute_timeout(self, route: _Route) -> None:
+        """The request overstayed its per-replica patience: cancel it
+        there (keeping its partial tokens as the new placement's hot
+        prefix) and re-place it elsewhere, original submission time
+        intact — a retry reports latency from the FIRST submission."""
+        handle = self._by_name(route.handle)
+        prefix: list = []
+        if handle is not None:
+            verdict = handle.cancel(route.request.id)
+            if verdict == 'active':
+                partial = handle.scheduler.results.get(route.request.id)
+                if partial is not None:
+                    prefix = list(partial.tokens)
+        route.attempt += 1
+        self._place(route.request, self._clock() - route.submitted, prefix,
+                    origin=route.handle, cause='timeout', route=route)
+
+    def _hedge(self, route: _Route) -> None:
+        targets = self._targets(exclude=route.handle)
+        if not targets:
+            return                   # nowhere to hedge
+        target = targets[0]
+        try:
+            target.restore(route.request,
+                           waited=self._clock() - route.submitted, prefix=())
+        except _DEAD as death:
+            self._fail(target, f'died at hedge ({death})')
+            return
+        except ValueError:
+            return
+        route.hedged = target.name
+        from tpusystem.observe.events import RequestRerouted
+        narration = RequestRerouted(
+            id=route.request.id, origin=route.handle, target=target.name,
+            where='cold', prefix=0, cause='hedge')
+        self._reroutes_pending.append(narration)
+        self._dispatch(narration)
+
+    # ------------------------------------------------------ degradation
+
+    def _fleet_shed(self) -> list:
+        """Past the fleet high watermark, shed down to the low one by
+        deadline slack across EVERY healthy replica's queue — the
+        globally most-doomed request goes first, no-deadline requests
+        last newest-first (each replica's own ordering contract, lifted
+        to the fleet). Maintains the brownout flag and narrates
+        fleet-scope ``LoadShed``/``Backpressure``."""
+        if self.watermarks is None:
+            return []
+        depth = sum(h.scheduler.queue_depth for h in self.healthy)
+        excess = self.watermarks.excess(depth)
+        if not excess:
+            if self.brownout and depth <= self.watermarks.low:
+                self.brownout = False
+                self._narrate_backpressure(depth)
+            return []
+        engaged_now = not self.brownout
+        self.brownout = True
+        candidates = []
+        for handle in self.healthy:
+            for request_id, slack, waited in \
+                    handle.scheduler.shed_candidates():
+                key = ((0, slack) if slack is not None else (1, waited))
+                candidates.append((key, request_id, slack, handle))
+        candidates.sort(key=lambda item: item[0])
+        shed = []
+        from tpusystem.observe.events import LoadShed
+        for _key, request_id, slack, handle in candidates[:excess]:
+            completion = handle.scheduler.shed(request_id)
+            if completion is None:
+                continue
+            self.results[request_id] = completion
+            self._routes.pop(request_id, None)
+            shed.append((completion, slack))
+            self._dispatch(LoadShed(id=request_id,
+                                    produced=len(completion.tokens),
+                                    queue_depth=depth, slack=slack))
+        if engaged_now:
+            self._narrate_backpressure(depth)
+        return shed
+
+    def _narrate_backpressure(self, depth: int) -> None:
+        from tpusystem.observe.events import Backpressure
+        self._dispatch(Backpressure(engaged=self.brownout,
+                                    queue_depth=depth))
+
+    # -------------------------------------------------------- autoscale
+
+    def _breathe(self) -> None:
+        """Traffic-driven sizing: sustained backpressure (or orphaned
+        rows) grows the fleet through ``provision``; sustained full
+        idleness retires the emptiest replica through ``release``."""
+        if self.autoscale is None:
+            return
+        pressured = self.brownout or bool(self._orphans) or any(
+            handle.backpressure for handle in self.healthy)
+        busy = bool(self._routes) or not all(
+            handle.idle for handle in self.healthy)
+        self._pressure_ticks = self._pressure_ticks + 1 if pressured else 0
+        self._idle_ticks = 0 if (pressured or busy) else self._idle_ticks + 1
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return
+        from tpusystem.observe.events import FleetResized
+        if (pressured and self._pressure_ticks >= self.autoscale.grow_after
+                and len(self.healthy) < self.autoscale.max_replicas):
+            handle = self.adopt(self._provision())
+            self._pressure_ticks = 0
+            self._cooldown = self.autoscale.cooldown
+            logger.info('fleet grew to %d replicas (+%r): sustained '
+                        'backpressure', len(self.healthy), handle.name)
+            self._dispatch(FleetResized(action='grow',
+                                        replicas=len(self.healthy),
+                                        cause='backpressure',
+                                        name=handle.name))
+            return
+        if (self._idle_ticks >= self.autoscale.shrink_after
+                and len(self.healthy) > self.autoscale.min_replicas):
+            idle = [handle for handle in self.healthy if handle.idle]
+            if not idle:
+                return               # never retire a replica holding work
+            victim = idle[-1]        # newest-added idle replica goes back
+            self.handles.remove(victim)
+            self._idle_ticks = 0
+            self._cooldown = self.autoscale.cooldown
+            logger.info('fleet shrank to %d replicas (-%r): traffic ebbed',
+                        len(self.healthy), victim.name)
+            self._dispatch(FleetResized(action='shrink',
+                                        replicas=len(self.healthy),
+                                        cause='idle', name=victim.name))
+            if self._release is not None:
+                self._release(victim)
+
+    # ------------------------------------------------------------- drain
+
+    @property
+    def idle(self) -> bool:
+        return (not self._routes and not self._orphans
+                and all(handle.idle for handle in self.healthy))
+
+    def run_until_idle(self, max_steps: int = 10_000) -> dict:
+        """Step until every routed request settles; returns request id
+        -> Completion across the whole fleet."""
+        for _ in range(max_steps):
+            if self.idle:
+                return self.results
+            self.step()
+        raise RuntimeError(
+            f'fleet did not drain in {max_steps} steps '
+            f'({len(self._routes)} in flight, {len(self._orphans)} '
+            f'orphaned, {len(self.healthy)} healthy replicas)')

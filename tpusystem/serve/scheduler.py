@@ -275,6 +275,29 @@ class Scheduler:
             return None
         return deadline - (now - pending.submitted)
 
+    def shed_candidates(self) -> list:
+        """Every queued request as ``(request_id, slack_seconds | None,
+        waited_seconds)`` — the shed-ordering input, exposed so a fleet
+        router can rank victims GLOBALLY across many replicas' queues
+        with the same contract the local shed uses: ascending slack
+        first (the request that will expire anyway), then no-deadline
+        requests newest-first (ascending waited). Active rows never
+        appear — they are never shed."""
+        now = self._clock()
+        return [(pending.request.id, self._slack(pending, now),
+                 now - pending.submitted) for pending in self._queue]
+
+    def shed(self, request_id: str) -> Completion | None:
+        """Shed ONE queued request by id (reason ``'shed'``; the victim
+        lands in :attr:`results` like any completion) — the fleet
+        router's victim hook. Returns None when the id is not queued
+        here (already admitted, completed, or somebody else's)."""
+        for pending in self._queue:
+            if pending.request.id == request_id:
+                self._queue.remove(pending)
+                return self._complete(pending, [], 'shed')
+        return None
+
     def _shed(self) -> list:
         """Past the high watermark, shed queued requests down to the low
         one by deadline slack — the request that will expire anyway goes
@@ -293,12 +316,15 @@ class Scheduler:
             return []
         self.backpressure = True
         now = self._clock()
+        # same ordering contract as shed_candidates() documents — kept
+        # over the pending objects directly so the overload path removes
+        # each victim once instead of rescanning the queue per shed
         order = sorted(
             self._queue,
             key=lambda pending: (
                 (0, self._slack(pending, now))
                 if pending.request.deadline is not None
-                else (1, -pending.submitted)))
+                else (1, now - pending.submitted)))
         shed = []
         for pending in order[:excess]:
             self._queue.remove(pending)

@@ -53,7 +53,8 @@ class InferenceService:
                                    clock=clock, max_queued=max_queued,
                                    watermarks=watermarks)
         self.producer = producer or Producer()
-        self._emitted = 0
+        self._clock = clock          # tok/s runs on the SAME injectable
+        self._emitted = 0            # clock as the scheduler's deadlines
         self._started = None         # first-step wall clock, for tok/s
         self._backpressure = False   # last narrated watermark state
         self.service = Service('serve')
@@ -91,7 +92,7 @@ class InferenceService:
     def step(self) -> None:
         """One scheduler iteration, narrated on the bus."""
         if self._started is None:
-            self._started = time.monotonic()
+            self._started = self._clock()
         tick = self.scheduler.step()
         # shed/backpressure narrate the depth that TRIGGERED them
         # (tick.shed_depth, pre-shed) — the final queue_depth is
@@ -126,7 +127,7 @@ class InferenceService:
                     reason=completion.reason,
                     seconds=completion.seconds))
         self._emitted += len(tick.admitted) + len(tick.emitted)
-        elapsed = time.monotonic() - self._started
+        elapsed = self._clock() - self._started
         self.producer.dispatch(ServeStepped(
             step=self.scheduler.steps, active=tick.active,
             queue_depth=tick.queue_depth, emitted=len(tick.emitted),
