@@ -60,6 +60,38 @@ import numpy as np
 
 TRIALS = 3   # timed runs per report (median printed, max-min as spread)
 
+_MANIFEST: dict | None = None
+
+
+def run_manifest() -> dict:
+    """The environment stamp every JSON row carries, so BENCH_r*.json
+    trajectories are comparable across containers: a value moved because
+    the code moved, or because jax/jaxlib/the backend did — the manifest
+    says which."""
+    global _MANIFEST
+    if _MANIFEST is None:
+        try:
+            import jaxlib
+            jaxlib_version = getattr(jaxlib, '__version__', None)
+        except ImportError:
+            jaxlib_version = None
+        _MANIFEST = {
+            'jax': jax.__version__,
+            'jaxlib': jaxlib_version,
+            'backend': jax.default_backend(),
+            'device_count': jax.device_count(),
+            'host_count': jax.process_count(),
+        }
+    return _MANIFEST
+
+
+def emit(row: dict) -> None:
+    """Print one benchmark row as a JSON line, stamped with the run
+    manifest (every row, including the subprocess probe rows re-stamped
+    in _overlap_probe_row)."""
+    print(json.dumps({**row, 'manifest': run_manifest()}))
+
+
 # bf16 peak FLOP/s per chip by device kind substring
 PEAKS = {
     'v5 lite': 197e12,  # v5e
@@ -106,13 +138,16 @@ def _overlap_probe_row(script_name: str, metric: str) -> None:
         lines = [line for line in probe.stdout.strip().splitlines()
                  if line.startswith('{')]
         if probe.returncode == 0 and lines:
-            print(lines[-1])
+            try:                     # re-stamp with THIS run's manifest
+                emit(json.loads(lines[-1]))
+            except ValueError:
+                print(lines[-1])
             return
         note = (probe.stderr.strip().splitlines() or ['no output'])[-1][:160]
     except (OSError, subprocess.TimeoutExpired) as error:
         note = str(error)[:160]
-    print(json.dumps({'metric': metric, 'value': None, 'unit': 'x',
-                      'note': f'probe failed: {note}'}))
+    emit({'metric': metric, 'value': None, 'unit': 'x',
+                      'note': f'probe failed: {note}'})
 
 
 def tp_overlap_row() -> None:
@@ -191,6 +226,117 @@ def fleet_recovery_row() -> None:
     `tpusystem/serve/fleet.py` — both arms drain token-exact vs an
     uninterrupted fleet)."""
     _overlap_probe_row('serve_fleet.py', 'fleet_recovery_seconds')
+
+
+def serve_ttft_row() -> None:
+    """Print the serving TTFT percentile row: p50/p95/p99 submit→first-
+    token over a staggered mixed-length workload on the tiny engine,
+    measured through the mergeable log-bucketed histogram
+    (``tpusystem.observe.metrics.Histogram`` — the same aggregation the
+    fleet dashboard charts). Percentiles, not means: tail latency is the
+    serving claim, and a mean TTFT hides exactly the overload the
+    watermark/brownout machinery exists for. Printed BEFORE the MFU
+    headline; never fails the run."""
+    try:
+        from tpusystem.models import gpt2_tiny
+        from tpusystem.observe.metrics import Histogram
+        from tpusystem.serve import Engine, Request, Scheduler
+
+        module = gpt2_tiny(dtype='float32')
+        rng = np.random.default_rng(3)
+        lengths = (5, 9, 7, 4, 11, 6, 8, 5, 10, 7, 6, 9)
+        budgets = (8, 6, 10, 5, 7, 9, 6, 10, 7, 8, 5, 6)
+        prompts = [rng.integers(0, 256, (n,)).tolist() for n in lengths]
+        params = module.init(jax.random.PRNGKey(0),
+                             jnp.asarray([prompts[0]], jnp.int32))['params']
+        engine = Engine(module, params, rows=4, block_size=8)
+        pending = list(zip(prompts, budgets))
+
+        def run_workload() -> Histogram:
+            scheduler = Scheduler(engine)
+            ttft = Histogram()
+            index = 0
+            for step in range(10_000):
+                # staggered arrivals: a new burst every other tick, so
+                # later requests genuinely queue behind seated rows
+                if step % 2 == 0 and index < len(pending):
+                    for prompt, budget in pending[index:index + 2]:
+                        scheduler.submit(Request(f'r{index}', prompt,
+                                                 budget))
+                        index += 1
+                tick = scheduler.step()
+                for _request, _admission, seconds in tick.admitted:
+                    ttft.add(seconds)
+                if index >= len(pending) and scheduler.idle:
+                    break
+            return ttft
+
+        run_workload()    # warm every prefill bucket + the decode step:
+        # without this, p99 charts one-time XLA compiles, not queueing
+        ttft = run_workload()
+        summary = ttft.summary()
+        emit({
+            'metric': 'serve_ttft_p50_p99',
+            'value': round(summary['p50'], 4),
+            'unit': 's (tiny engine, staggered mixed workload, p50)',
+            'p95': round(summary['p95'], 4),
+            'p99': round(summary['p99'], 4),
+            'count': summary['count'],
+        })
+    except Exception as error:  # never cost the headline its run
+        emit({'metric': 'serve_ttft_p50_p99', 'value': None, 'unit': 's',
+              'note': f'probe failed: {str(error)[:160]}'})
+
+
+def trace_overhead_row() -> None:
+    """Print the tracer's serving-path cost: scheduler steps/s with a
+    live ``observe.Tracer`` attached vs the default ``tracer=None``, the
+    ``sentinel_overhead`` protocol (median of TRIALS per arm). The
+    acceptance budget is < 0.02 for the DISABLED tracer — which shares
+    the off arm's code path exactly (one ``is not None`` test per hook),
+    so the printed value bounds it from above: even tracing ENABLED must
+    stay cheap, because spans record only at lifecycle edges, never per
+    token. Printed BEFORE the MFU headline; never fails the run."""
+    try:
+        from tpusystem.models import gpt2_tiny
+        from tpusystem.observe import Tracer
+        from tpusystem.serve import Engine, Request, Scheduler
+
+        module = gpt2_tiny(dtype='float32')
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, 256, (n,)).tolist() for n in (6, 8, 5, 7)]
+        params = module.init(jax.random.PRNGKey(0),
+                             jnp.asarray([prompts[0]], jnp.int32))['params']
+        engine = Engine(module, params, rows=4, block_size=8)
+
+        def run_once(tracer) -> float:
+            scheduler = Scheduler(engine, tracer=tracer)
+            for index, prompt in enumerate(prompts):
+                scheduler.submit(Request(f'r{index}', prompt, 48))
+            start = time.perf_counter()
+            scheduler.run()
+            return scheduler.steps / (time.perf_counter() - start)
+
+        run_once(None)               # warm the decode/prefill compiles
+        # interleave the arms (off, on, off, on, ...) so machine-load
+        # drift lands on both equally; report the median paired rates
+        pairs = [(run_once(None), run_once(Tracer('bench')))
+                 for _ in range(max(TRIALS, 5))]
+        ratios = sorted(on / off for off, on in pairs)
+        middle = ratios[len(ratios) // 2]
+        off = sorted(off for off, _ in pairs)[len(pairs) // 2]
+        on = off * middle
+        emit({
+            'metric': 'trace_overhead',
+            'value': round(1.0 - on / off, 4),
+            'unit': 'fraction of serve steps/s (tracer on vs off)',
+            'tracer_on_steps_per_sec': round(on, 2),
+            'tracer_off_steps_per_sec': round(off, 2),
+        })
+    except Exception as error:  # never cost the headline its run
+        emit({'metric': 'trace_overhead', 'value': None,
+              'unit': 'fraction of serve steps/s',
+              'note': f'probe failed: {str(error)[:160]}'})
 
 
 BATCH, SEQ = 16, 1024
@@ -279,17 +425,17 @@ def sentinel_overhead_row() -> None:
             return steps / sorted(elapsed)[len(elapsed) // 2]
 
         off, on = arm_rate(False), arm_rate(True)
-        print(json.dumps({
+        emit({
             'metric': 'sentinel_overhead',
             'value': round(1.0 - on / off, 4),
             'unit': 'fraction of steps/s',
             'guard_on_steps_per_sec': round(on, 4),
             'guard_off_steps_per_sec': round(off, 4),
-        }))
+        })
     except Exception as error:  # never cost the headline its run
-        print(json.dumps({'metric': 'sentinel_overhead', 'value': None,
+        emit({'metric': 'sentinel_overhead', 'value': None,
                           'unit': 'fraction of steps/s',
-                          'note': f'probe failed: {str(error)[:160]}'}))
+                          'note': f'probe failed: {str(error)[:160]}'})
 
 
 def recovery_seconds_row() -> None:
@@ -339,17 +485,17 @@ def recovery_seconds_row() -> None:
             hot_source, hot = timed(store)
             disk_source, disk = timed(None)
         assert (hot_source, disk_source) == ('hot', 'disk')
-        print(json.dumps({
+        emit({
             'metric': 'recovery_seconds',
             'value': round(hot, 4),
             'unit': 's (hot restore, tiny model)',
             'disk_seconds': round(disk, 4),
             'hot_speedup_vs_disk': round(disk / hot, 2) if hot else None,
-        }))
+        })
     except Exception as error:  # never cost the headline its run
-        print(json.dumps({'metric': 'recovery_seconds', 'value': None,
+        emit({'metric': 'recovery_seconds', 'value': None,
                           'unit': 's',
-                          'note': f'probe failed: {str(error)[:160]}'}))
+                          'note': f'probe failed: {str(error)[:160]}'})
 
 
 def decode_rows() -> None:
@@ -383,27 +529,27 @@ def decode_rows() -> None:
             elapsed_trials.append(time.perf_counter() - start)
         elapsed = sorted(elapsed_trials)[len(elapsed_trials) // 2]
         to_tok = lambda secs: batch * decode / secs
-        print(json.dumps({
+        emit({
             'metric': 'decode_tok_s',
             'value': round(to_tok(elapsed)),
             'spread': round(to_tok(min(elapsed_trials))
                             - to_tok(max(elapsed_trials))),
             'unit': 'tok/s (125M, batch 8, prefill 128, decode 128)',
-        }))
+        })
         auto_bytes = streamed_bytes(module, params, 'auto')
         int8_bytes = streamed_bytes(module, params, 'int8')
-        print(json.dumps({
+        emit({
             'metric': 'decode_stream_bytes',
             'value': auto_bytes,
             'unit': 'bytes/step (streamed param tree, stream_dtype=auto)',
             'int8_bytes': int8_bytes,
             'int8_reduction': round(auto_bytes / int8_bytes, 2),
-        }))
+        })
     except Exception as error:  # never cost the headline its run
         for metric, unit in (('decode_tok_s', 'tok/s'),
                              ('decode_stream_bytes', 'bytes/step')):
-            print(json.dumps({'metric': metric, 'value': None, 'unit': unit,
-                              'note': f'probe failed: {str(error)[:160]}'}))
+            emit({'metric': metric, 'value': None, 'unit': unit,
+                              'note': f'probe failed: {str(error)[:160]}'})
 
 
 def main() -> None:
@@ -435,24 +581,24 @@ def main() -> None:
     if peak:
         to_mfu = lambda secs: step_flops * steps / secs / peak
         mfu = achieved / peak
-        print(json.dumps({
+        emit({
             'metric': 'gpt2_125m_train_mfu_1chip',
             'value': round(mfu, 4),
             'spread': round(to_mfu(min(elapsed_trials))
                             - to_mfu(max(elapsed_trials)), 4),
             'unit': 'MFU',
             'vs_baseline': round(mfu / 0.5, 4),
-        }))
+        })
     else:  # CPU fallback: report throughput
         to_sps = lambda secs: steps / secs
-        print(json.dumps({
+        emit({
             'metric': 'gpt2_125m_train_steps_per_sec_cpu',
             'value': round(steps / elapsed, 4),
             'spread': round(to_sps(min(elapsed_trials))
                             - to_sps(max(elapsed_trials)), 4),
             'unit': 'steps/s',
             'vs_baseline': 1.0,
-        }))
+        })
 
 
 if __name__ == '__main__':
@@ -468,4 +614,6 @@ if __name__ == '__main__':
     serve_recovery_row()
     fleet_recovery_row()
     embedding_row()
+    serve_ttft_row()
+    trace_overhead_row()
     main()

@@ -1,0 +1,83 @@
+"""Minimal TensorBoard event-file *reader* for tests.
+
+The writer (``tpusystem/observe/tensorboard.py``) hand-rolls the
+TFRecord + Event-proto format; this is its mirror — a varint/field
+parser just big enough to read scalar summaries back, so TB-handler
+tests assert **parsed tags and values** instead of poking at raw bytes
+or file sizes. Not a test module: shared via ``from tests.tb import``.
+"""
+
+import io
+import struct
+
+
+def read_records(path):
+    """Raw TFRecord payloads from one event file (CRCs skipped — the
+    writer's own format test verifies them once)."""
+    records = []
+    with open(path, 'rb') as handle:
+        while header := handle.read(8):
+            (length,) = struct.unpack('<Q', header)
+            handle.read(4)                      # length crc
+            records.append(handle.read(length))
+            handle.read(4)                      # payload crc
+    return records
+
+
+def _varint(stream):
+    shift = result = 0
+    while True:
+        byte = stream.read(1)[0]
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result
+        shift += 7
+
+
+def _walk(data):
+    """One level of proto fields: {field_number: value-or-[bytes, ...]}."""
+    stream = io.BytesIO(data)
+    fields = {}
+    while stream.tell() < len(data):
+        key = _varint(stream)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            fields[field] = _varint(stream)
+        elif wire == 1:
+            fields[field] = struct.unpack('<d', stream.read(8))[0]
+        elif wire == 5:
+            fields[field] = struct.unpack('<f', stream.read(4))[0]
+        elif wire == 2:
+            fields.setdefault(field, []).append(stream.read(_varint(stream)))
+    return fields
+
+
+def parse_scalars(record):
+    """{tag: (value, step)} from one serialized Event proto record."""
+    scalars = {}
+    top = _walk(record)
+    step = top.get(2, 0)
+    for summary in top.get(5, []):
+        for value in _walk(summary).get(1, []):
+            fields = _walk(value)
+            scalars[fields[1][0].decode()] = (fields[2], step)
+    return scalars
+
+
+def read_scalars(logdir, history=False):
+    """Every scalar from every event file under ``logdir``.
+
+    ``history=False`` (default): {tag: (value, step)} with the LAST
+    write winning — the one-shot assertion shape. ``history=True``:
+    {tag: [(value, step), ...]} in write order — for charts written at
+    several steps.
+    """
+    out = {}
+    for event_file in sorted(logdir.glob('events.out.tfevents.*')):
+        for record in read_records(event_file)[1:]:    # [0] = version
+            for tag, pair in parse_scalars(record).items():
+                if history:
+                    out.setdefault(tag, []).append(pair)
+                else:
+                    out[tag] = pair
+    return out

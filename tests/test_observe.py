@@ -6,8 +6,9 @@ point DI overrides at test fixtures, assert the stored state — the
 framework itself is never mocked.
 """
 
+import dataclasses
 import logging
-import struct
+import pathlib
 
 import pytest
 
@@ -51,57 +52,11 @@ def test_logging_consumer_reports_each_event(caplog):
     assert 'hash-1' in text and '50.0 steps/s' in text
 
 
-# --- minimal TFRecord/Event readers to verify the on-disk format ---------
+# --- the minimal TFRecord/Event reader lives in tests/tb.py (shared by
+# every TB-handler test, so assertions parse tags/values back instead of
+# byte-poking); the format test below still exercises it end to end ------
 
-def read_records(path):
-    records = []
-    with open(path, 'rb') as handle:
-        while header := handle.read(8):
-            (length,) = struct.unpack('<Q', header)
-            handle.read(4)                      # length crc
-            records.append(handle.read(length))
-            handle.read(4)                      # payload crc
-    return records
-
-
-def parse_scalars(record):
-    """Extract {tag: (value, step)} from a serialized Event proto."""
-    import io
-    scalars = {}
-
-    def varint(stream):
-        shift = result = 0
-        while True:
-            byte = stream.read(1)[0]
-            result |= (byte & 0x7F) << shift
-            if not byte & 0x80:
-                return result
-            shift += 7
-
-    def walk(data, step):
-        stream = io.BytesIO(data)
-        fields = {}
-        while stream.tell() < len(data):
-            key = varint(stream)
-            field, wire = key >> 3, key & 7
-            if wire == 0:
-                fields[field] = varint(stream)
-            elif wire == 1:
-                fields[field] = struct.unpack('<d', stream.read(8))[0]
-            elif wire == 5:
-                fields[field] = struct.unpack('<f', stream.read(4))[0]
-            elif wire == 2:
-                fields.setdefault(field, []).append(stream.read(varint(stream)))
-        return fields
-
-    top = walk(record, 0)
-    step = top.get(2, 0)
-    for summary in top.get(5, []):
-        for value in walk(summary, step).get(1, []):
-            fields = walk(value, step)
-            tag = fields[1][0].decode()
-            scalars[tag] = (fields[2], step)
-    return scalars
+from tests.tb import parse_scalars, read_records, read_scalars  # noqa: E402
 
 
 def test_summary_writer_emits_valid_tfrecord_events(tmp_path):
@@ -198,3 +153,136 @@ def test_tracking_consumer_persists_module_metadata_and_weights(tracked):
 
     # weights snapshotted under the aggregate id at its epoch
     assert fixtures['repository'].latest(model) == 1
+
+
+# --- the event-inventory drift guard -------------------------------------
+# Every dataclass event must either have a TensorBoard handler or sit on
+# the explicit exemption list below (with its reason), and every event
+# name must appear in docs/observability.md — the inventory can no longer
+# silently outgrow its charts or its docs.
+
+# events that deliberately have NO TensorBoard chart; each entry names why
+TB_EXEMPT = {
+    'Iterated',             # an epoch edge — the checkpoint/tracking
+                            # consumers' trigger, nothing scalar to chart
+    'StepTimed',            # throughput is charted from Trained metrics;
+                            # StepTimed feeds the logging consumer
+    'RequestEvicted',       # a cancellation is caller intent, not system
+                            # state; completions/expiries carry the charts
+    'RequestReplayed',      # EngineRestarted charts replayed/resubmitted
+                            # counts; per-row detail lives on the trace
+    'WorkerRelaunched',     # WorkerExited's per-rank exit chart already
+                            # counts every relaunch verdict
+    'WorldResizeProposed',  # proposals can outnumber commits under churn;
+                            # WorldResized charts the committed epochs
+}
+
+
+def _event_classes():
+    from tpusystem.observe import events as events_module
+    return [value for value in vars(events_module).values()
+            if dataclasses.is_dataclass(value) and isinstance(value, type)
+            and value.__module__ == events_module.__name__]
+
+
+def test_every_event_has_a_tb_handler_or_an_explicit_exemption():
+    from tpusystem.observe.metrics import serve_metrics_consumer
+    consumer = tensorboard_consumer()
+    charted = {cls.__name__ for cls in consumer.types.values()}
+    charted |= {cls.__name__
+                for cls in serve_metrics_consumer().types.values()}
+    classes = _event_classes()
+    assert classes, 'found no event dataclasses'
+    missing = [cls.__name__ for cls in classes
+               if cls.__name__ not in charted
+               and cls.__name__ not in TB_EXEMPT]
+    assert not missing, (
+        f'events with neither a TensorBoard handler nor an entry on the '
+        f'TB_EXEMPT list (add a chart or an exemption WITH its reason): '
+        f'{missing}')
+    stale = sorted(TB_EXEMPT & charted)
+    assert not stale, f'exempted events that ARE charted now: {stale}'
+
+
+def test_every_event_is_documented_in_observability_md():
+    docs = (pathlib.Path(__file__).parent.parent / 'docs'
+            / 'observability.md').read_text()
+    missing = [cls.__name__ for cls in _event_classes()
+               if cls.__name__ not in docs]
+    assert not missing, (
+        f'events missing from docs/observability.md (add them to the '
+        f'event table): {missing}')
+
+
+# --- profile.trace: only stop what was started ---------------------------
+
+def test_trace_refuses_double_start_with_typed_error(monkeypatch):
+    """A failed start_trace (trace already active) must surface as the
+    typed ProfilerBusy carrying the ORIGINAL error — and must NOT run
+    stop_trace, which would kill the pre-existing trace and mask the
+    real problem with a second 'no trace running' error."""
+    import jax
+
+    from tpusystem.observe import ProfilerBusy, trace
+
+    calls = []
+    monkeypatch.setattr(
+        jax.profiler, 'start_trace',
+        lambda logdir: (_ for _ in ()).throw(
+            RuntimeError('Only one profile may be run at a time.')))
+    monkeypatch.setattr(jax.profiler, 'stop_trace',
+                        lambda: calls.append('stop'))
+    with pytest.raises(ProfilerBusy, match='already active'):
+        with trace('/tmp/unused'):
+            raise AssertionError('body must not run on a failed start')
+    assert calls == [], 'stop_trace ran for a trace that never started'
+
+
+def test_trace_stops_what_it_started(monkeypatch):
+    import jax
+
+    from tpusystem.observe import trace
+
+    calls = []
+    monkeypatch.setattr(jax.profiler, 'start_trace',
+                        lambda logdir: calls.append(('start', logdir)))
+    monkeypatch.setattr(jax.profiler, 'stop_trace',
+                        lambda: calls.append('stop'))
+    with trace('/tmp/logs'):
+        pass
+    assert calls == [('start', '/tmp/logs'), 'stop']
+    # the body's own exception still stops the trace it started
+    calls.clear()
+    with pytest.raises(ValueError):
+        with trace('/tmp/logs'):
+            raise ValueError('body failed')
+    assert calls == [('start', '/tmp/logs'), 'stop']
+
+
+# --- fleet/* charts, parsed back (the previously untested handlers) ------
+
+def test_tensorboard_fleet_charts_parse_back(tmp_path):
+    from tpusystem.observe.events import (FleetResized, ReplicaUnhealthy,
+                                          RequestRerouted)
+
+    consumer = tensorboard_consumer()
+    writer = SummaryWriter(tmp_path / 'run')
+    consumer.dependency_overrides[tensorboard_module.writer] = lambda: writer
+    consumer.consume(ReplicaUnhealthy(name='rep0', cause='died mid-step',
+                                      routed=3))
+    consumer.consume(RequestRerouted(id='a', origin='rep0', target='rep1',
+                                     where='hot', prefix=4,
+                                     cause='failover'))
+    consumer.consume(RequestRerouted(id='b', origin='rep0', target='rep2',
+                                     where='cold', prefix=0,
+                                     cause='failover'))
+    consumer.consume(FleetResized(action='grow', replicas=4,
+                                  cause='backpressure', name='rep3'))
+    writer.close()
+    scalars = read_scalars(tmp_path / 'run', history=True)
+    assert scalars['fleet/unhealthy_total'] == [(1.0, 1)]
+    assert scalars['fleet/rehomed_requests'] == [(3.0, 1)]
+    # per reroute: a running total and the hot prefix carried over
+    assert scalars['fleet/rerouted_total'] == [(1.0, 1), (2.0, 2)]
+    assert scalars['fleet/reroute_prefix'] == [(4.0, 1), (0.0, 2)]
+    assert scalars['fleet/replicas'] == [(4.0, 1)]

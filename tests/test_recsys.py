@@ -610,8 +610,12 @@ class TestStreamingEval:
         consumer.consume(RecsysEvaluated(Model(), {'auc': 0.7,
                                                    'recall@10': 0.4}))
         board.close()
-        logged = list(tmp_path.glob('events.out.tfevents.*'))
-        assert logged and logged[0].stat().st_size > 0
+        from tests.tb import read_scalars
+        scalars = read_scalars(tmp_path)    # parsed back, not size-poked
+        value, step = scalars['dlrm-test/recsys/auc']
+        assert value == pytest.approx(0.7) and step == 3
+        value, step = scalars['dlrm-test/recsys/recall@10']
+        assert value == pytest.approx(0.4) and step == 3
 
 
 class TestSyntheticClicks:

@@ -536,6 +536,9 @@ def test_service_narrates_the_request_lifecycle(served):
 
 
 def test_tensorboard_serve_handlers_chart_the_events(tmp_path):
+    import pytest
+
+    from tests.tb import read_scalars
     from tpusystem.observe.events import RequestAdmitted, ServeStepped
     from tpusystem.observe.tensorboard import (SummaryWriter,
                                                tensorboard_consumer, writer)
@@ -548,8 +551,14 @@ def test_tensorboard_serve_handlers_chart_the_events(tmp_path):
     consumer.consume(ServeStepped(step=3, active=2, queue_depth=1,
                                   emitted=2, tokens_per_sec=123.4))
     board.flush()
-    events = list(tmp_path.glob('events.out.tfevents.*'))
-    assert events and events[0].stat().st_size > 120
+    scalars = read_scalars(tmp_path)        # parsed back, not byte-poked
+    value, step = scalars['serve/ttft_seconds']
+    assert value == pytest.approx(0.01) and step == 1   # admission counter
+    assert scalars['serve/queue_depth_at_admit'] == (2.0, 1)
+    assert scalars['serve/queue_depth'] == (1.0, 3)
+    assert scalars['serve/active_rows'] == (2.0, 3)
+    value, step = scalars['serve/tok_s']
+    assert value == pytest.approx(123.4) and step == 3
 
 
 def test_serve_levers_pick_the_backend_default():

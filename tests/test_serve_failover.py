@@ -860,6 +860,7 @@ def test_expiry_of_a_request_whose_row_is_mid_prefill(served):
 
 
 def test_tensorboard_failover_handlers_chart_the_events(tmp_path):
+    from tests.tb import read_scalars
     from tpusystem.observe.events import (Backpressure, EngineRestarted,
                                           LoadShed)
     from tpusystem.observe.tensorboard import (SummaryWriter,
@@ -874,8 +875,15 @@ def test_tensorboard_failover_handlers_chart_the_events(tmp_path):
                               slack=-0.5))
     consumer.consume(Backpressure(engaged=True, queue_depth=7))
     board.flush()
-    events = list(tmp_path.glob('events.out.tfevents.*'))
-    assert events and events[0].stat().st_size > 120
+    scalars = read_scalars(tmp_path)        # parsed back, not byte-poked
+    value, step = scalars['serve/recovery_seconds']
+    assert value == pytest.approx(0.8) and step == 1    # restart counter
+    assert scalars['serve/replayed'] == (2.0, 1)
+    assert scalars['serve/resubmitted'] == (1.0, 1)
+    assert scalars['serve/shed'] == (7.0, 1)            # triggering depth
+    value, _ = scalars['serve/shed_slack']
+    assert value == pytest.approx(-0.5)
+    assert scalars['serve/backpressure'] == (1.0, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -889,6 +897,9 @@ def test_sigkill_subprocess_drill_under_supervisor():
     itself mid-decode, the Supervisor relaunches it (signal death =
     worker-lost), the relaunch recovers the journal from the
     supervisor's memstore and finishes — completions token-exact vs an
-    uninterrupted run of the same worker, decode compiled once."""
+    uninterrupted run of the same worker, decode compiled once, and the
+    worker's flight-recorder post-mortem (write-ahead ring, read back by
+    the supervisor onto WorkerExited) reconstructs exactly the emitted
+    prefixes the journal replay re-prefilled."""
     from __graft_entry__ import _dryrun_serve_failover
     _dryrun_serve_failover(2)

@@ -715,20 +715,31 @@ def served():
     return module, params
 
 
-def real_fleet(module, params, clock, n=3, *, cadence=1, rows=2):
+def real_fleet(module, params, clock, n=3, *, cadence=1, rows=2,
+               trace=False):
     """N supervised replicas over REAL engines, each journaling into its
-    own supervisor-RAM MemStore (what a SIGKILL leaves behind)."""
+    own supervisor-RAM MemStore (what a SIGKILL leaves behind). With
+    ``trace=True`` every replica and the router carry a Tracer on the
+    shared clock; returns them as the 4th element (else Nones)."""
+    from tpusystem.observe import Tracer
     stores = [MemStore() for _ in range(n)]
     handles = []
+    tracers = []
     for i in range(n):
-        def build(i=i):
+        tracer = Tracer(f'rep{i}', clock=clock) if trace else None
+        tracers.append(tracer)
+
+        def build(i=i, tracer=tracer):
             return Scheduler(Engine(module, params, rows=rows,
-                                    block_size=8), clock=clock)
+                                    block_size=8), clock=clock,
+                             tracer=tracer)
         replica = ServingReplica(build, identity=f'rep{i}',
                                  client=stores[i], cadence=cadence,
                                  clock=clock)
         handles.append(ReplicaHandle(replica))
-    return Router(handles, clock=clock), handles, stores
+    router_tracer = Tracer('router', clock=clock) if trace else None
+    router = Router(handles, clock=clock, tracer=router_tracer)
+    return router, handles, stores, (router_tracer, tracers)
 
 
 def mixed_workload(vocab=256, seed=7):
@@ -764,7 +775,7 @@ def drive(router, wave, victims=(), max_steps=400):
 
 class TestFleetChaosDrill:
 
-    def test_preemption_wave_mid_stream_token_exact(self, served):
+    def test_preemption_wave_mid_stream_token_exact(self, served, tmp_path):
         """THE acceptance drill: 3 replicas serving a mixed workload, a
         PreemptionWave kills one mid-stream; every journaled request
         completes token-exact vs the uninterrupted fleet (hot handoff
@@ -776,12 +787,13 @@ class TestFleetChaosDrill:
         clock = FakeClock()
 
         # the uninterrupted single-fleet reference
-        reference_router, _, _ = real_fleet(module, params, clock, n=3)
+        reference_router, _, _, _ = real_fleet(module, params, clock, n=3)
         for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
             reference_router.submit(Request(f'r{index}', prompt, budget))
         reference = reference_router.run_until_idle()
 
-        router, handles, stores = real_fleet(module, params, clock, n=3)
+        router, handles, stores, (router_tracer, tracers) = real_fleet(
+            module, params, clock, n=3, trace=True)
         for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
             router.submit(Request(f'r{index}', prompt, budget))
         # rep0 now holds 2 seated rows + 1 queued: the kill exercises
@@ -800,6 +812,42 @@ class TestFleetChaosDrill:
         # followed routed NOTHING onto the dead replica
         assert handles[0].placements == placements['rep0']
 
+        # the trace plane: merge every process's spans (dead replica's
+        # included — a real pod recovers them from its supervisor RAM
+        # over the blob plane) and the export is valid Chrome trace JSON
+        # holding ONE connected trace per request: the replayed/rerouted
+        # spans on the survivors parent to the ORIGINAL submission's
+        # trace_id, zero orphan spans
+        import json
+
+        from tpusystem.observe.trace import connected_traces
+
+        for tracer in tracers:
+            router_tracer.merge(tracer)
+        path = router_tracer.export(tmp_path / 'fleet-trace.json')
+        payload = json.loads(path.read_text())
+        events = [event for event in payload['traceEvents']
+                  if event['ph'] in ('X', 'i')]
+        processes = {event['pid']: event['args']['name']
+                     for event in payload['traceEvents']
+                     if event['ph'] == 'M'}
+        by_trace = connected_traces(payload['traceEvents'])   # 0 orphans
+        for index in range(len(prompts)):
+            rid = f'r{index}'
+            roots = [event for event in events
+                     if event['name'] == f'request {rid}']
+            assert len(roots) == 1, (rid, len(roots))   # ONE trace each
+            group = by_trace[roots[0]['args']['trace_id']]
+            owners = {event['args'].get('request') for event in group}
+            assert owners <= {rid, None}, (rid, owners)
+        # every hot handoff's trace crosses engines: spans on the dead
+        # replica AND on a survivor, linked by the one trace_id
+        crossed = [trace_id for trace_id, group in by_trace.items()
+                   if len({processes[event['pid']] for event in group
+                           if processes[event['pid']].startswith('rep')})
+                   >= 2]
+        assert crossed, 'no trace crossed engines after the handoff'
+
     @pytest.mark.slow
     def test_double_kill_wave_with_buddy_journal(self, served):
         """The heavy multi-replica kill drill (slow): a staggered wave
@@ -810,12 +858,12 @@ class TestFleetChaosDrill:
         module, params = served
         prompts, budgets = mixed_workload()
         clock = FakeClock()
-        reference_router, _, _ = real_fleet(module, params, clock, n=3)
+        reference_router, _, _, _ = real_fleet(module, params, clock, n=3)
         for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
             reference_router.submit(Request(f'r{index}', prompt, budget))
         reference = reference_router.run_until_idle()
 
-        router, handles, stores = real_fleet(module, params, clock, n=3)
+        router, handles, stores, _ = real_fleet(module, params, clock, n=3)
         # rep1's journal ALSO lands in a buddy store (the supervisor
         # replication rider's landing zone on a real pod)
         buddy = MemStore()
@@ -848,6 +896,7 @@ class TestFleetChaosDrill:
 
 
 def test_tensorboard_fleet_handlers_chart_the_events(tmp_path):
+    from tests.tb import read_scalars
     from tpusystem.observe.events import (FleetResized, ReplicaUnhealthy,
                                           RequestRerouted)
     from tpusystem.observe.tensorboard import (SummaryWriter,
@@ -864,9 +913,63 @@ def test_tensorboard_fleet_handlers_chart_the_events(tmp_path):
     consumer.consume(FleetResized(action='grow', replicas=4,
                                   cause='backpressure', name='rep3'))
     board.flush()
-    (event_file,) = list(tmp_path.glob('events.out.tfevents.*'))
-    data = event_file.read_bytes()
-    for tag in (b'fleet/unhealthy_total', b'fleet/rehomed_requests',
-                b'fleet/rerouted_total', b'fleet/reroute_prefix',
-                b'fleet/replicas'):
-        assert tag in data, tag
+    scalars = read_scalars(tmp_path)        # parsed back, not byte-poked
+    assert scalars['fleet/unhealthy_total'] == (1.0, 1)
+    assert scalars['fleet/rehomed_requests'] == (3.0, 1)
+    assert scalars['fleet/rerouted_total'] == (1.0, 1)
+    assert scalars['fleet/reroute_prefix'] == (4.0, 1)
+    assert scalars['fleet/replicas'] == (4.0, 1)
+
+
+def test_refused_submit_resets_the_trace_for_a_retry():
+    """FleetSaturated's contract is retry-later: the refusal must close
+    its root span truthfully AND unbind it from the request, so the
+    retry roots a FRESH trace instead of parenting into a closed one."""
+    from tpusystem.observe import Tracer
+
+    clock = FakeClock()
+    tracer = Tracer('router', clock=clock)
+    router, handles, _ = fake_fleet(clock, n=1, max_queued=1,
+                                    router_knobs={'tracer': tracer})
+    assert router.submit(Request('r0', [1], 8)) == 'rep0'
+    refused = Request('r1', [1], 8)
+    with pytest.raises(FleetSaturated):
+        router.submit(refused)
+    assert refused.trace is None         # unbound for the retry
+    refusal_roots = [e for e in tracer.events()
+                     if e['name'] == 'request r1' and e['ph'] == 'X']
+    assert refusal_roots[0]['args']['reason'] == 'refused'
+    # backlog drains; the retry lands and roots a SECOND, fresh trace
+    router.run_until_idle()
+    assert router.submit(refused) == 'rep0'
+    router.run_until_idle()
+    roots = [e for e in tracer.events() if e['name'] == 'request r1']
+    assert len(roots) == 2
+    assert len({e['args']['trace_id'] for e in roots}) == 2
+
+
+def test_invalid_submit_closes_the_trace_root_before_reraising():
+    """A request that can never run (oversized budget) re-raises the
+    scheduler's ValueError to the caller — but with a tracer attached
+    the router must close its root span (reason 'invalid') and unbind
+    request.trace, not leak an open phantom root per bad submission."""
+    from tpusystem.observe import Tracer
+
+    clock = FakeClock()
+    tracer = Tracer('router', clock=clock)
+
+    class Oversized(FakeScheduler):
+        def submit(self, request):
+            raise ValueError(f'{request.id!r}: exceeds engine capacity')
+
+    replica = FakeReplica('rep0', clock=clock)
+    replica.scheduler = Oversized(clock=clock)
+    router = Router([ReplicaHandle(replica)], clock=clock, tracer=tracer)
+    bad = Request('bad', [1], 10_000)
+    with pytest.raises(ValueError, match='capacity'):
+        router.submit(bad)
+    assert bad.trace is None
+    assert router._trace_roots == {}
+    (root,) = [e for e in tracer.events() if e['ph'] == 'X']
+    assert root['args']['reason'] == 'invalid'
+    assert 'open' not in root['args']

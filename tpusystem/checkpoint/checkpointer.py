@@ -139,21 +139,34 @@ class Checkpointer:
     def __init__(self, root: str | pathlib.Path, *, max_to_keep: int | None = 3,
                  keep_every: int | None = None,
                  async_save: bool = True, save_retries: int = 2,
-                 retry_backoff: float = 0.5) -> None:
+                 retry_backoff: float = 0.5, tracer: Any = None) -> None:
         """``max_to_keep`` bounds the rolling window; ``keep_every`` pins
         every Nth step forever in addition (GC policy: a long run keeps
         recent checkpoints for resume plus periodic ones for analysis
         /rollback instead of losing all history to the window).
         ``save_retries`` bounds the retry loop a flaky filesystem gets
         before :meth:`save` gives up (exponential backoff starting at
-        ``retry_backoff`` seconds)."""
+        ``retry_backoff`` seconds). ``tracer`` (an
+        :class:`~tpusystem.observe.Tracer`, default None = no tracing
+        work) wraps every save/restore dispatch in a span, so checkpoint
+        cost shows on the same timeline as the recoveries it bounds."""
         self.root = pathlib.Path(root).absolute()
         self.max_to_keep = max_to_keep
         self.keep_every = keep_every
         self.async_save = async_save
         self.save_retries = save_retries
         self.retry_backoff = retry_backoff
+        self.tracer = tracer
         self._managers: dict[str, ocp.CheckpointManager] = {}
+
+    def _span(self, name: str, identity: str, epoch: Any):
+        """A tracing span around one checkpoint operation (nullcontext
+        when tracing is off — the default costs nothing)."""
+        if self.tracer is None:
+            import contextlib
+            return contextlib.nullcontext()
+        return self.tracer.span(name, cat='checkpoint',
+                                args={'identity': identity, 'epoch': epoch})
 
     def _manager(self, identity: str) -> ocp.CheckpointManager:
         if identity not in self._managers:
@@ -191,27 +204,29 @@ class Checkpointer:
         against transient filesystem errors before giving up.
         """
         self._surface_async_errors(identity)
-        if extras is not None:
-            # sidecar BEFORE the array commit: a kill between the two must
-            # not leave a committed step with no cursor (an orphan sidecar
-            # for a never-committed step is harmless and pruned later)
-            _atomic_write(self._extras_path(identity, epoch),
-                          json.dumps(extras))
-        manager = self._manager(identity)
-        for attempt in range(self.save_retries + 1):
-            try:
-                manager.save(epoch, args=ocp.args.StandardSave(state))
-                break
-            except OSError as error:
-                if attempt == self.save_retries:
-                    raise
-                delay = self.retry_backoff * (2 ** attempt)
-                logger.warning(
-                    'checkpoint save %s/%s/%d failed (%s); retry %d/%d in '
-                    '%.1fs', self.root, identity, epoch, error, attempt + 1,
-                    self.save_retries, delay)
-                time.sleep(delay)
-        self._prune_extras(identity)
+        with self._span('checkpoint-save', identity, epoch):
+            if extras is not None:
+                # sidecar BEFORE the array commit: a kill between the two
+                # must not leave a committed step with no cursor (an orphan
+                # sidecar for a never-committed step is harmless, pruned
+                # later)
+                _atomic_write(self._extras_path(identity, epoch),
+                              json.dumps(extras))
+            manager = self._manager(identity)
+            for attempt in range(self.save_retries + 1):
+                try:
+                    manager.save(epoch, args=ocp.args.StandardSave(state))
+                    break
+                except OSError as error:
+                    if attempt == self.save_retries:
+                        raise
+                    delay = self.retry_backoff * (2 ** attempt)
+                    logger.warning(
+                        'checkpoint save %s/%s/%d failed (%s); retry %d/%d '
+                        'in %.1fs', self.root, identity, epoch, error,
+                        attempt + 1, self.save_retries, delay)
+                    time.sleep(delay)
+            self._prune_extras(identity)
 
     def _surface_async_errors(self, identity: str) -> None:
         """Re-raise a background async-save failure at the *next* call.
@@ -359,15 +374,16 @@ class Checkpointer:
         used, falling back over torn/corrupt dirs (each discard logged).
         """
         abstract = abstract_like(target)
-        if epoch is not None:
-            if not self.verify(identity, epoch):
-                available = self.committed(identity)
-                raise FileNotFoundError(
-                    f'no committed checkpoint for identity {identity!r} at '
-                    f'epoch {epoch} under {self.root} '
-                    f'(committed epochs: {available or "none"})')
-            return self._restore_step(identity, epoch, abstract)
-        return self._restore_newest(identity, abstract)[0]
+        with self._span('checkpoint-restore', identity, epoch):
+            if epoch is not None:
+                if not self.verify(identity, epoch):
+                    available = self.committed(identity)
+                    raise FileNotFoundError(
+                        f'no committed checkpoint for identity {identity!r} '
+                        f'at epoch {epoch} under {self.root} '
+                        f'(committed epochs: {available or "none"})')
+                return self._restore_step(identity, epoch, abstract)
+            return self._restore_newest(identity, abstract)[0]
 
     def _restore_step(self, identity: str, epoch: int, abstract: Any) -> Any:
         """One step's restore, with the legacy-shape fallback.

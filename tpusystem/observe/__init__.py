@@ -18,9 +18,22 @@ from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
                                       RolledBack, ServeStepped, StepTimed,
                                       Trained, Validated, WorkerExited,
                                       WorkerRelaunched)
+from tpusystem.observe.flight import FlightRecorder
 from tpusystem.observe.ledger import EventLedger, LedgerDivergence
 from tpusystem.observe.logs import logging_consumer
-from tpusystem.observe.profile import StepTimer, annotate, step_span, trace
+from tpusystem.observe.metrics import (Histogram, ServeLatency,
+                                       serve_metrics_consumer)
+# the trace MODULE must import before profile's trace FUNCTION: importing
+# a submodule binds it as a package attribute, and the later function
+# import deliberately wins — `observe.trace` stays the device-profiler
+# context manager it has always been. Span tracing is reached as
+# `observe.Tracer` (preferred) or `from tpusystem.observe.trace import
+# ...`; NOT via attribute access on the package (`import
+# tpusystem.observe.trace; tpusystem.observe.trace.Tracer` resolves the
+# shadowing function and fails — the price of keeping the old name).
+from tpusystem.observe.trace import Span, TraceContext, Tracer
+from tpusystem.observe.profile import (ProfilerBusy, StepTimer, annotate,
+                                       step_span, trace)
 from tpusystem.observe.tensorboard import SummaryWriter, tensorboard_consumer
 from tpusystem.observe.tracking import (
     checkpoint_consumer, experiment, metrics_store, models_store,
@@ -37,5 +50,8 @@ __all__ = [
     'metrics_store', 'models_store',
     'modules_store', 'iterations_store', 'repository',
     'EventLedger', 'LedgerDivergence', 'StepTimer', 'annotate', 'step_span',
-    'trace',
+    'trace', 'ProfilerBusy',
+    'Tracer', 'Span', 'TraceContext',
+    'Histogram', 'ServeLatency', 'serve_metrics_consumer',
+    'FlightRecorder',
 ]

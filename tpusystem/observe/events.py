@@ -272,12 +272,18 @@ class WorkerExited:
     """The supervised worker process ended; ``action`` is the contract
     verdict (``relaunch`` / ``done`` / ``halt`` / ``crash-loop`` /
     ``drain`` for a forwarded preemption), ``reason`` the human-readable
-    cause (exit-code name or signal)."""
+    cause (exit-code name or signal). ``postmortem`` is what the worker
+    saw: the parsed flight-recorder dump
+    (:class:`~tpusystem.observe.FlightRecorder`) the supervisor read
+    back after the exit — its last entries are the worker's final ticks
+    — or None when flight recording is off or the worker died before
+    its first dump."""
     rank: int
     code: int
     action: str
     uptime: float
     reason: str | None = None
+    postmortem: Any = None
 
 
 @event

@@ -27,11 +27,31 @@ from tpusystem.observe.events import StepTimed
 from tpusystem.services.prodcon import Producer
 
 
+class ProfilerBusy(RuntimeError):
+    """``jax.profiler.start_trace`` refused — almost always because a
+    trace is already active (nested :func:`trace`, or a leftover from a
+    span that never stopped). Typed so callers can skip-or-queue instead
+    of crashing, and so the ORIGINAL error is what surfaces — the old
+    code ran ``stop_trace`` in its ``finally`` even when the start had
+    failed, masking the real problem with a second 'no trace running'
+    error."""
+
+
 @contextlib.contextmanager
 def trace(logdir: str) -> Iterator[None]:
     """Capture a device trace (XLA timeline, memory viewer) for the enclosed
-    span into ``logdir``; open with TensorBoard's profile plugin."""
-    jax.profiler.start_trace(logdir)
+    span into ``logdir``; open with TensorBoard's profile plugin.
+
+    Only a trace this context actually *started* is stopped on exit: a
+    failed start (e.g. a trace already active) raises the typed
+    :exc:`ProfilerBusy` and leaves the pre-existing trace untouched."""
+    try:
+        jax.profiler.start_trace(logdir)
+    except RuntimeError as error:
+        raise ProfilerBusy(
+            f'jax.profiler.start_trace({logdir!r}) refused: {error} — a '
+            f'device trace is already active; stop it (or nest '
+            f'annotate()/step_span() instead, which compose)') from error
     try:
         yield
     finally:

@@ -200,6 +200,7 @@ class ElasticCoordinator:
                  *, policy: ElasticPolicy | None = None, producer: Any = None,
                  on_resize: Callable[[ResizeDecision], None] | None = None,
                  clock: Callable[[], float] = time.monotonic,
+                 tracer: Any = None,
                  members: tuple[int, ...] | None = 'from-size') -> None:
         self.transport = transport
         self.rank = rank
@@ -207,6 +208,10 @@ class ElasticCoordinator:
         self.producer = producer
         self.on_resize = on_resize
         self._clock = clock
+        # observe.Tracer | None: each committed wave becomes a parent
+        # span (wave-open → resumed) with one child span per stage
+        # transition — the span form of ElasticTimeline, same clock
+        self.tracer = tracer
         if members == 'from-size':
             members = tuple(range(size)) if size is not None else None
         self.members: tuple[int, ...] | None = (
@@ -516,6 +521,19 @@ class ElasticCoordinator:
         timeline.update(stages)
         timeline.setdefault('resumed', now - anchor)
         seconds = now - anchor
+        if self.tracer is not None and timeline:
+            root = self.tracer.record(
+                f'elastic-resize epoch{decision.epoch}', anchor, now,
+                cat='elastic', args={'epoch': decision.epoch,
+                                     'size': decision.size, 'step': step,
+                                     'source': source})
+            previous = ('wave-open', anchor)
+            for stage, offset in sorted(timeline.items(),
+                                        key=lambda kv: kv[1]):
+                self.tracer.record(f'{previous[0]}→{stage}', previous[1],
+                                   anchor + offset, cat='elastic',
+                                   trace=root.context)
+                previous = (stage, anchor + offset)
         self._dispatch(ElasticTimeline(epoch=decision.epoch,
                                        size=decision.size, step=step,
                                        source=source, seconds=seconds,

@@ -177,16 +177,28 @@ def exit_for_restart(reason: BaseException) -> SystemExit:
     is a *bug*, not a recoverable fault, and returns the generic
     :data:`FAILURE_EXIT`: mapping unknown exceptions to a restartable
     code (the old behavior) would relaunch a deterministic crash forever.
+
+    Every mapping also flushes any installed
+    :class:`~tpusystem.observe.FlightRecorder` with the verdict stamped
+    (``reason``/``code``), so a typed contract exit always leaves its
+    black box on disk before the process ends.
     """
     if isinstance(reason, WorkerLostError):
-        return SystemExit(LOST_WORKER_EXIT)
-    if isinstance(reason, Preempted):
-        return SystemExit(PREEMPTED_EXIT)
-    if isinstance(reason, WorldResizedError):
-        return SystemExit(RESIZED_EXIT)
-    if isinstance(reason, DivergenceError):
-        return SystemExit(DIVERGED_EXIT)
-    return SystemExit(FAILURE_EXIT)
+        code = LOST_WORKER_EXIT
+    elif isinstance(reason, Preempted):
+        code = PREEMPTED_EXIT
+    elif isinstance(reason, WorldResizedError):
+        code = RESIZED_EXIT
+    elif isinstance(reason, DivergenceError):
+        code = DIVERGED_EXIT
+    else:
+        code = FAILURE_EXIT
+    try:   # the black box must never cost the contract its exit code
+        from tpusystem.observe.flight import dump_installed
+        dump_installed(reason=type(reason).__name__, code=code)
+    except Exception:                            # pragma: no cover
+        logger.exception('flight-recorder exit dump failed')
+    return SystemExit(code)
 
 
 def recovery_consumer(policy: str = 'abort') -> Consumer:

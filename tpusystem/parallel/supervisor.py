@@ -147,7 +147,9 @@ class Supervisor:
     def __init__(self, argv: list[str], *, rank: int = 0,
                  memstore: MemStore | bool = True,
                  transport: Any = None, buddy: int | None = None,
-                 producer: Any = None, env: dict[str, str] | None = None,
+                 producer: Any = None, tracer: Any = None,
+                 flight_path: str | None = None,
+                 env: dict[str, str] | None = None,
                  backoff_base: float = 1.0, backoff_cap: float = 30.0,
                  backoff_jitter: float = 0.25, seed: int = 0,
                  crash_loop_k: int = 3, crash_loop_window: float = 30.0,
@@ -161,6 +163,14 @@ class Supervisor:
         self.transport = transport
         self.buddy = buddy
         self.producer = producer
+        # observe.Tracer | None: every detect→first-step recovery becomes
+        # a parent span with one child span per stage transition — the
+        # span form of RecoveryTimeline, on the same clock
+        self.tracer = tracer
+        # where the worker's flight recorder writes (exported to it as
+        # TPUSYSTEM_FLIGHT); after every exit the supervisor reads the
+        # post-mortem back and attaches it to WorkerExited
+        self.flight_path = flight_path
         self.env = dict(env or {})
         self.backoff_base = backoff_base
         self.backoff_cap = backoff_cap
@@ -341,6 +351,19 @@ class Supervisor:
         timeline, self._timeline = self._timeline, None
         detect = timeline.pop('detect')
         stages = {stage: at - detect for stage, at in timeline.items()}
+        if self.tracer is not None and timeline:
+            restored = self._restore_info or {}
+            done = max(timeline.values())
+            root = self.tracer.record(
+                f'recovery rank{self.rank}', detect, done, cat='recovery',
+                args={'rank': self.rank, 'source': restored.get('source'),
+                      'step': restored.get('step')})
+            previous = ('detect', detect)
+            for stage, at in sorted(timeline.items(), key=lambda kv: kv[1]):
+                self.tracer.record(f'{previous[0]}→{stage}',
+                                   previous[1], at, cat='recovery',
+                                   trace=root.context)
+                previous = (stage, at)
         restore = self._restore_info or {}
         seconds = stages.get('first-step', 0.0)
         logger.info('recovery complete on rank %d: %.3fs detect->first-step '
@@ -358,6 +381,23 @@ class Supervisor:
     def _dispatch(self, event: Any) -> None:
         if self.producer is not None:
             self.producer.dispatch(event)
+
+    def _postmortem(self) -> Any:
+        """The worker's flight-recorder dump, read back after an exit —
+        'what the worker saw' attached to the verdict about it. None
+        when recording is off or the worker died before its first
+        dump (the recorder's write-ahead cadence bounds that window)."""
+        if self.flight_path is None:
+            return None
+        from tpusystem.observe.flight import FlightRecorder
+        return FlightRecorder.read(self.flight_path)
+
+    def _worker_exited(self, code: int, action: str, uptime: float,
+                       reason: str | None) -> None:
+        from tpusystem.observe.events import WorkerExited
+        self._dispatch(WorkerExited(rank=self.rank, code=code, action=action,
+                                    uptime=uptime, reason=reason,
+                                    postmortem=self._postmortem()))
 
     # ------------------------------------------------------------------
     # the control loop
@@ -420,7 +460,7 @@ class Supervisor:
             self.close()
 
     def _supervise(self) -> int:
-        from tpusystem.observe.events import WorkerExited, WorkerRelaunched
+        from tpusystem.observe.events import WorkerRelaunched
         attempt = 0          # backoff ladder position (reset by progress)
         rapid = 0            # consecutive crash-loop samples
         while True:
@@ -444,6 +484,16 @@ class Supervisor:
             env = {**os.environ, **self.env}
             if self.server is not None:
                 env.update(self.server.env)
+            if self.flight_path is not None:
+                from tpusystem.observe.flight import ENV_FLIGHT
+                env[ENV_FLIGHT] = str(self.flight_path)
+                # clear the previous worker's post-mortem before launch: a
+                # worker that dies before its FIRST dump must attach None,
+                # not its predecessor's final ticks
+                try:
+                    os.unlink(self.flight_path)
+                except OSError:
+                    pass
             self._first_step_at = None
             self._restore_info = None
             launched = self._clock()
@@ -470,16 +520,12 @@ class Supervisor:
                         'reporting the eviction as exit %d', self.rank,
                         reason, PREEMPTED_EXIT)
                     code = PREEMPTED_EXIT
-                self._dispatch(WorkerExited(rank=self.rank, code=code,
-                                            action='drain', uptime=uptime,
-                                            reason=reason))
+                self._worker_exited(code, 'drain', uptime, reason)
                 logger.info('rank %d: preemption drain done (%s)', self.rank,
                             reason)
                 return code
             if code == 0:
-                self._dispatch(WorkerExited(rank=self.rank, code=0,
-                                            action='done', uptime=uptime,
-                                            reason=reason))
+                self._worker_exited(0, 'done', uptime, reason)
                 return 0
             if self._resize.is_set() and (
                     code in RESTART_EXITS
@@ -494,9 +540,7 @@ class Supervisor:
                 self._apply_resize()
                 self._timeline = {'detect': self._clock()}
                 self.restarts += 1
-                self._dispatch(WorkerExited(rank=self.rank, code=code,
-                                            action='resize', uptime=uptime,
-                                            reason=reason))
+                self._worker_exited(code, 'resize', uptime, reason)
                 logger.info('rank %d: worker exited %s for a world resize; '
                             'relaunching under the new spec', self.rank,
                             reason)
@@ -505,9 +549,7 @@ class Supervisor:
                 code < 0 and -code not in _HALT_SIGNALS)
             if not restartable:
                 action = 'halt'
-                self._dispatch(WorkerExited(rank=self.rank, code=code,
-                                            action=action, uptime=uptime,
-                                            reason=reason))
+                self._worker_exited(code, action, uptime, reason)
                 logger.error(
                     'rank %d: worker exited %d (%s) — not a restart code; '
                     'halting for triage%s', self.rank, code, reason,
@@ -531,9 +573,7 @@ class Supervisor:
             if rapid >= self.crash_loop_k or (
                     self.max_restarts is not None
                     and self.restarts >= self.max_restarts):
-                self._dispatch(WorkerExited(rank=self.rank, code=code,
-                                            action='crash-loop',
-                                            uptime=uptime, reason=reason))
+                self._worker_exited(code, 'crash-loop', uptime, reason)
                 logger.error(
                     'rank %d: crash loop — %d consecutive restartable exits '
                     'within %.0fs of first-step; giving up with exit %d',
@@ -541,9 +581,7 @@ class Supervisor:
                 return CRASH_LOOP_EXIT
 
             self._timeline = {'detect': self._clock()}
-            self._dispatch(WorkerExited(rank=self.rank, code=code,
-                                        action='relaunch', uptime=uptime,
-                                        reason=reason))
+            self._worker_exited(code, 'relaunch', uptime, reason)
             backoff = min(self.backoff_cap, self.backoff_base * 2 ** attempt)
             backoff *= 1.0 + self.backoff_jitter * self._rng.random()
             attempt += 1

@@ -517,7 +517,8 @@ class ServingReplica:
                  cadence: int = 1, watchdog: StepWatchdog | None = None,
                  deadman: bool = False, producer: Any = None,
                  clock: Callable[[], float] = time.monotonic,
-                 fault: Callable[[int], None] | None = None) -> None:
+                 fault: Callable[[int], None] | None = None,
+                 recorder: Any = None) -> None:
         if deadman and (watchdog is None or watchdog.stall_after is None):
             raise ValueError('deadman=True needs a watchdog with '
                              'stall_after set (the timer interval)')
@@ -531,6 +532,11 @@ class ServingReplica:
         self.producer = producer
         self._clock = clock
         self._fault = fault
+        # the black box (observe.FlightRecorder | None): every tick's
+        # admissions/emissions land in its write-ahead ring, so a SIGKILL
+        # leaves a post-mortem whose tail matches the journal the
+        # Supervisor recovers; an EngineStalled verdict dumps explicitly
+        self.recorder = recorder
         self.recovered = False
         self.relaunches = 0
         self.results: dict[str, Any] = {}
@@ -575,6 +581,11 @@ class ServingReplica:
             report = replay(self.scheduler, rows, producer=self.producer)
             self.recovered = True
         self.report = report
+        if self.recorder is not None and (cause is not None
+                                          or recovered is not None):
+            self.recorder.note('engine-restarted', cause=cause or 'relaunch',
+                               replayed=len(report.replayed),
+                               resubmitted=len(report.resubmitted))
         if cause is not None or recovered is not None:
             seconds = self._clock() - started
             self._dispatch_restart(cause or 'relaunch', report, seconds)
@@ -638,9 +649,24 @@ class ServingReplica:
                     self.watchdog.observe(self._clock() - started)
         except EngineStalled as stall:
             logger.warning('serving replica %r: %s', self.identity, stall)
+            if self.recorder is not None:   # the watchdog verdict is a
+                # post-mortem moment even though the process survives:
+                # dump what the engine saw BEFORE the rebuild replaces it
+                self.recorder.note('engine-stalled', kind=stall.kind,
+                                   seconds=round(stall.seconds, 6),
+                                   threshold=round(stall.threshold, 6))
+                self.recorder.dump(reason='engine-stalled')
             self.relaunch('stalled')
             return None
         self.results.update(self.scheduler.results)
+        if self.recorder is not None:
+            self.recorder.note(
+                'tick', step=self.scheduler.steps,
+                admitted={request.id: admission.token
+                          for request, admission, _ in tick.admitted},
+                emitted=dict(tick.emitted),
+                completed=[completion.request.id
+                           for completion in tick.completed])
         return tick
 
     @property

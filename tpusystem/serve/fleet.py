@@ -359,7 +359,7 @@ class Router:
                  autoscale: AutoscalePolicy | None = None,
                  provision: Callable[[], ReplicaHandle] | None = None,
                  release: Callable[[ReplicaHandle], None] | None = None,
-                 producer: Any = None,
+                 producer: Any = None, tracer: Any = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self.handles = [handle if isinstance(handle, ReplicaHandle)
                         else ReplicaHandle(handle) for handle in handles]
@@ -377,6 +377,14 @@ class Router:
         self._provision = provision
         self._release = release
         self.producer = producer
+        # observe.Tracer | None: the router roots ONE trace per request
+        # (request.trace then travels with the work — through every
+        # replica's scheduler, the journal, and any reroute — so a
+        # request's whole fleet journey is one connected trace); reroute
+        # and hedge decisions mark as instants in that trace. None = no
+        # tracing work on any path.
+        self.tracer = tracer
+        self._trace_roots: dict[str, Any] = {}
         self._clock = clock
         self.results: dict[str, Any] = {}
         self.brownout = False
@@ -427,6 +435,11 @@ class Router:
         targets = self._targets()
         if not targets:
             raise NoHealthyReplica('no healthy replica in the fleet')
+        if self.tracer is not None and request.trace is None:
+            root = self.tracer.begin(f'request {request.id}', cat='request',
+                                     args={'request': request.id})
+            request.trace = root.context
+            self._trace_roots[request.id] = root
         full = 0
         for handle in targets:
             try:
@@ -434,11 +447,27 @@ class Router:
             except (QueueFull, Saturated):
                 full += 1
                 continue
+            except ValueError:
+                # a request that can never run (oversized prompt/budget):
+                # a caller error, not a routing signal — close its trace
+                # truthfully before re-raising so the root can't leak open
+                if self.tracer is not None:
+                    self.tracer.end(self._trace_roots.pop(request.id, None),
+                                    reason='invalid')
+                    request.trace = None
+                raise
             except _DEAD as death:
                 self._fail(handle, f'died at submit ({death})')
                 continue
             self._routes[request.id] = _Route(request, handle.name, now, now)
             return handle.name
+        if self.tracer is not None:      # refused: close the trace truthfully
+            refused_root = self._trace_roots.pop(request.id, None)
+            if refused_root is not None:
+                self.tracer.end(refused_root, reason='refused')
+                # a documented retry-after-FleetSaturated must root a
+                # FRESH trace, not parent into this closed one
+                request.trace = None
         if full:
             raise FleetSaturated(
                 f'request {request.id!r} refused: every healthy replica '
@@ -452,6 +481,9 @@ class Router:
         verdict (orphans count as ``'queued'``: silently dropped, the
         scheduler's queued-cancel contract)."""
         route = self._routes.pop(request_id, None)
+        if self.tracer is not None:
+            self.tracer.end(self._trace_roots.pop(request_id, None),
+                            reason='cancelled')
         orphaned = [entry for entry in self._orphans
                     if entry[0].id == request_id]
         for entry in orphaned:
@@ -497,6 +529,10 @@ class Router:
         from tpusystem.observe.events import ReplicaUnhealthy
         self._dispatch(ReplicaUnhealthy(name=handle.name, cause=cause,
                                         routed=len(in_flight)))
+        if self.tracer is not None:  # its own one-span trace: the verdict
+            self.tracer.instant('replica-unhealthy', cat='fleet',
+                                args={'replica': handle.name, 'cause': cause,
+                                      'routed': len(in_flight)})
         recovered = recover_journal(handle.identity, handle.journal_clients)
         rows = recovered[1] if recovered is not None else []
         if recovered is None:
@@ -569,6 +605,13 @@ class Router:
             route = self._routes[request.id] = _Route(
                 request, placed.name, now - waited, now)
         route.handle, route.routed_at = placed.name, now
+        if self.tracer is not None:
+            self.tracer.instant(
+                'reroute', cat='fleet', trace=request.trace,
+                args={'request': request.id, 'origin': origin,
+                      'target': placed.name,
+                      'where': 'hot' if emitted else 'cold',
+                      'prefix': len(emitted), 'cause': cause})
         from tpusystem.observe.events import RequestRerouted
         narration = RequestRerouted(
             id=request.id, origin=origin, target=placed.name,
@@ -675,6 +718,10 @@ class Router:
             return                   # a hedge already won elsewhere
         self.results[request_id] = completion
         completed.append(request_id)
+        if self.tracer is not None:
+            self.tracer.end(self._trace_roots.pop(request_id, None),
+                            reason=completion.reason, replica=handle.name,
+                            produced=len(completion.tokens))
         route = self._routes.pop(request_id, None)
         if route is None:
             return
@@ -733,6 +780,11 @@ class Router:
         except ValueError:
             return
         route.hedged = target.name
+        if self.tracer is not None:
+            self.tracer.instant(
+                'hedge', cat='fleet', trace=route.request.trace,
+                args={'request': route.request.id, 'origin': route.handle,
+                      'target': target.name})
         from tpusystem.observe.events import RequestRerouted
         narration = RequestRerouted(
             id=route.request.id, origin=route.handle, target=target.name,
@@ -774,6 +826,9 @@ class Router:
             if completion is None:
                 continue
             self.results[request_id] = completion
+            if self.tracer is not None:
+                self.tracer.end(self._trace_roots.pop(request_id, None),
+                                reason='shed')
             self._routes.pop(request_id, None)
             shed.append((completion, slack))
             self._dispatch(LoadShed(id=request_id,

@@ -79,6 +79,12 @@ class Request:
     max_new: int
     stop_token: int | None = None
     deadline: float | None = None
+    trace: object = None
+    # the request's causal identity (tpusystem.observe.TraceContext),
+    # assigned by the first traced component that sees it (router or
+    # scheduler) and carried THROUGH the journal's pack/unpack — so a
+    # row replayed or rerouted onto a different engine still parents its
+    # spans to the original submission's trace. None when tracing is off.
 
 
 @dataclasses.dataclass
@@ -140,7 +146,8 @@ class Scheduler:
     def __init__(self, engine: Engine, *, prefill_budget: int = 512,
                  clock: Callable[[], float] = time.monotonic,
                  max_queued: int | None = None,
-                 watermarks: Watermarks | None = None) -> None:
+                 watermarks: Watermarks | None = None,
+                 tracer=None) -> None:
         if max_queued is not None and max_queued < 1:
             raise ValueError(f'max_queued must be >= 1 (or None for '
                              f'unbounded), got {max_queued}')
@@ -150,11 +157,14 @@ class Scheduler:
         self.watermarks = watermarks
         self.journal: RequestJournal | None = None
         self.backpressure = False
-        self._clock = clock
+        self.tracer = tracer         # observe.Tracer | None (None = zero
+        self._clock = clock          # tracing work on every path below)
         self._queue: deque[_Pending] = deque()
         self._seated: dict[int, _Pending] = {}      # row -> pending
         self.results: dict[str, Completion] = {}
         self.steps = 0
+        self._trace_open: dict[str, object] = {}    # request id -> Span
+        self._trace_roots: dict[str, object] = {}   # roots THIS end owns
 
     @property
     def queue_depth(self) -> int:
@@ -203,6 +213,8 @@ class Scheduler:
         self._queue.append(pending)
         if self.journal is not None:
             self.journal.record(request, pending.submitted)
+        if self.tracer is not None:
+            self._trace_enqueue(request)
 
     def restore(self, request: Request, *, waited: float = 0.0,
                 prefix=()) -> None:
@@ -225,6 +237,44 @@ class Scheduler:
         self._queue.append(pending)
         if self.journal is not None:
             self.journal.restored(request, pending.submitted, prefix)
+        if self.tracer is not None:
+            self._trace_enqueue(request, prefix=len(prefix))
+
+    # ------------------------------------------------------------ tracing
+    # (every call below is guarded by `self.tracer is not None` at the
+    # call site — tracing off means NO extra work on the serving path)
+
+    def _trace_enqueue(self, request: Request, prefix: int | None = None):
+        """Open the request's 'queued' span. The FIRST traced component
+        that sees a request roots its trace (a fronting Router usually
+        did already — then ``request.trace`` carries its context and the
+        spans here parent into it, which is exactly how a replayed row
+        on a different engine stays in the original trace)."""
+        if request.trace is None:
+            root = self.tracer.begin(f'request {request.id}', cat='request',
+                                     args={'request': request.id})
+            request.trace = root.context
+            self._trace_roots[request.id] = root
+        args = {'request': request.id}
+        if prefix is not None:       # a journal replay / reroute re-entry
+            args['prefix'] = prefix
+            args['replayed'] = True
+        self._trace_open[request.id] = self.tracer.begin(
+            'queued', cat='serve', trace=request.trace, args=args)
+
+    def _trace_seated(self, request: Request, row: int) -> None:
+        self.tracer.end(self._trace_open.pop(request.id, None))
+        self._trace_open[request.id] = self.tracer.begin(
+            'decode', cat='serve', trace=request.trace,
+            args={'request': request.id, 'row': row})
+
+    def _trace_finish(self, request: Request, reason: str,
+                      produced: int) -> None:
+        self.tracer.end(self._trace_open.pop(request.id, None),
+                        reason=reason, produced=produced)
+        root = self._trace_roots.pop(request.id, None)
+        if root is not None:         # this scheduler rooted the trace
+            self.tracer.end(root, reason=reason, produced=produced)
 
     def cancel(self, request_id: str) -> str | None:
         """Cancel a request wherever it is: ``'queued'`` (silently
@@ -236,6 +286,8 @@ class Scheduler:
                 self._queue.remove(pending)
                 if self.journal is not None:
                     self.journal.finished(request_id)
+                if self.tracer is not None:
+                    self._trace_finish(pending.request, 'cancelled', 0)
                 return 'queued'
         for row, pending in list(self._seated.items()):
             if pending.request.id == request_id:
@@ -361,6 +413,8 @@ class Scheduler:
             admitted.append((request, admission, ttft))
             if self.journal is not None:
                 self.journal.seated(request.id, admission.token)
+            if self.tracer is not None:
+                self._trace_seated(request, admission.row)
             if admission.finished:
                 completed.append(self._complete(
                     pending, [admission.token], admission.reason))
@@ -396,6 +450,9 @@ class Scheduler:
         self.results[pending.request.id] = completion
         if self.journal is not None:
             self.journal.finished(pending.request.id)
+        if self.tracer is not None:
+            self._trace_finish(pending.request, reason,
+                               len(completion.tokens))
         return completion
 
     def run(self, max_steps: int = 10_000) -> dict:

@@ -44,14 +44,14 @@ class InferenceService:
                  rows: int = 4, block_size: int = 16,
                  blocks: int | None = None, prefill_budget: int = 512,
                  clock=time.monotonic, max_queued: int | None = None,
-                 watermarks=None, **levers) -> None:
+                 watermarks=None, tracer=None, **levers) -> None:
         knobs = {**serve_levers(), **levers}
         self.engine = Engine(module, params, rows=rows,
                              block_size=block_size, blocks=blocks, **knobs)
         self.scheduler = Scheduler(self.engine,
                                    prefill_budget=prefill_budget,
                                    clock=clock, max_queued=max_queued,
-                                   watermarks=watermarks)
+                                   watermarks=watermarks, tracer=tracer)
         self.producer = producer or Producer()
         self._clock = clock          # tok/s runs on the SAME injectable
         self._emitted = 0            # clock as the scheduler's deadlines
