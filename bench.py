@@ -24,6 +24,9 @@ config plus the per-step streamed weight bytes auto-vs-int8 — the
 roofline lever, ``benchmarks/decode_roofline.py``), then the
 ``serve_tok_s`` row (continuous batching vs static padded batching
 through the serving engine, ``benchmarks/serve_bench.py headline``),
+then the ``serve_shared_prefix_speedup`` row (radix prefix sharing on
+a shared-system-prompt workload vs no sharing,
+``benchmarks/serve_bench.py shared``),
 then the ``serve_recovery_seconds`` row (kill -> first replayed token
 through the serving failover layer, hot journal replay vs cold
 re-submit, ``benchmarks/serve_recovery.py headline``),
@@ -120,7 +123,8 @@ def peak_flops(device) -> float | None:
     return None
 
 
-def _overlap_probe_row(script_name: str, metric: str) -> None:
+def _overlap_probe_row(script_name: str, metric: str,
+                       arg: str = 'headline') -> None:
     """Print one latency-hiding A/B row: ``benchmarks/<script> headline``
     in a subprocess (each script picks the real mesh on multi-chip
     hardware and re-execs onto the virtual CPU mesh otherwise — smoke
@@ -133,7 +137,7 @@ def _overlap_probe_row(script_name: str, metric: str) -> None:
     import sys
     script = pathlib.Path(__file__).parent / 'benchmarks' / script_name
     try:
-        probe = subprocess.run([sys.executable, str(script), 'headline'],
+        probe = subprocess.run([sys.executable, str(script), arg],
                                capture_output=True, text=True, timeout=1800)
         lines = [line for line in probe.stdout.strip().splitlines()
                  if line.startswith('{')]
@@ -205,6 +209,17 @@ def serve_row() -> None:
     BASELINE.md "serve protocol" — CPU numbers are smoke, the >= 2x
     speedup ratio is the architectural claim)."""
     _overlap_probe_row('serve_bench.py', 'serve_tok_s')
+
+
+def serve_shared_prefix_row() -> None:
+    """The radix prefix-sharing row: delivered tok/s on a shared-system-
+    prompt workload with ``share_prefix=True`` vs without
+    (`benchmarks/serve_bench.py shared`; BASELINE.md "shared-prefix
+    serve protocol" — CPU numbers are smoke, the >= 1.5x speedup ratio
+    is the architectural claim and every completion is asserted
+    token-exact against standalone ``generate()``)."""
+    _overlap_probe_row('serve_bench.py', 'serve_shared_prefix_speedup',
+                       arg='shared')
 
 
 def serve_recovery_row() -> None:
@@ -611,6 +626,7 @@ if __name__ == '__main__':
     resize_seconds_row()
     decode_rows()
     serve_row()
+    serve_shared_prefix_row()
     serve_recovery_row()
     fleet_recovery_row()
     embedding_row()

@@ -22,14 +22,28 @@ for) over wall time, so the static arm pays for its dead rows. Per-phase
 rows decompose the continuous arm (prefill / admit / decode dispatch
 time from the engine's own counters).
 
+A third arm measures **radix prefix sharing** (``shared``): N requests
+that open with one long system prompt and differ only in a short user
+suffix — the shape RAG/chat traffic has — served with
+``share_prefix=True`` vs without. With sharing, admission adopts the
+cached prefix blocks and prefills only the uncached suffix, so the
+prefill cost per request collapses from ``bucket(prefix + suffix)`` to
+``bucket(suffix)``; the ``prefix_hit_rate`` row reports the fraction of
+prompt tokens adopted and every completion is asserted token-exact
+against standalone ``generate()``.
+
 Every row is one machine-readable JSON line (the ``decode_roofline.py``
 convention); the LAST line is the ``serve_tok_s`` headline ``bench.py``
-forwards. On CPU the numbers are smoke (documented in BASELINE.md
-"serve protocol" — the TPU protocol uses the 125M decode config); the
-*ratio* is the architectural claim: continuous batching >= 2x static on
-this workload.
+forwards, and the ``serve_shared_prefix_speedup`` row is forwarded as
+its own ``bench.py`` line. On CPU the numbers are smoke (documented in
+BASELINE.md "serve protocol" and "shared-prefix serve protocol" — the
+TPU protocol uses the 125M decode config); the *ratios* are the
+architectural claims: continuous batching >= 2x static, and sharing
+>= 1.5x no-sharing delivered tok/s on the shared-prompt workload.
 
-Run: ``python benchmarks/serve_bench.py [headline]``.
+Run: ``python benchmarks/serve_bench.py [headline|shared]`` —
+``shared`` prints only the prefix-sharing section (its last line is the
+``serve_shared_prefix_speedup`` row ``bench.py`` forwards).
 """
 
 from __future__ import annotations
@@ -131,7 +145,104 @@ def continuous_arm(module, params, prompts, budgets) -> tuple[float, int, dict]:
     return sorted(trials)[len(trials) // 2], sum(budgets), phases
 
 
+def shared_recipe():
+    """Model + shared-prompt workload: one long system prefix, short
+    per-request suffixes. TPU: the BASELINE decode config. CPU: the
+    dim-256 preset (same reasoning as :func:`recipe` — dispatch-bound
+    tiny models hide the prefill win)."""
+    if ON_TPU:
+        module = GPT2(dropout=0.0, vocab_size=50304, max_seq=512)
+        prefix_len, vocab, max_new = 384, 50257, 16
+    else:
+        module = gpt2_tiny(dtype='float32', layers=4, dim=256, heads=8,
+                           vocab_size=1024, max_seq=256)
+        prefix_len, vocab, max_new = 192, 1024, 8
+    rng = np.random.default_rng(7)
+    prefix = rng.integers(0, vocab, (prefix_len,)).astype(np.int32)
+    prompts = [np.concatenate([prefix, rng.integers(0, vocab, (8,))
+                               .astype(np.int32)]) for _ in range(8)]
+    params = module.init(jax.random.PRNGKey(0),
+                         jnp.asarray(prompts[0][None]))['params']
+    return module, params, prompts, max_new
+
+
+def shared_arm(module, params, prompts, max_new,
+               share: bool) -> tuple[float, int, float]:
+    """Median wall seconds for the shared-prompt workload through the
+    scheduler with prefix sharing on or off, plus delivered tokens and
+    the engine-lifetime prefix hit rate. ONE engine per arm: the warmup
+    run compiles AND (sharing arm) populates the radix tree, so the
+    timed trials measure the steady state a long-lived replica serves
+    from — every trial's prefix blocks adopted, only suffixes
+    prefilled."""
+    engine = Engine(module, params, rows=ROWS, block_size=16,
+                    share_prefix=share)
+
+    def run_once() -> None:
+        scheduler = Scheduler(engine)
+        for index, prompt in enumerate(prompts):
+            scheduler.submit(Request(f's{index}', list(prompt), max_new))
+        results = scheduler.run()
+        delivered = sum(len(c.tokens) for c in results.values())
+        assert delivered == max_new * len(prompts)
+
+    run_once()                                   # warm/compile + warm tree
+    trials = []
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        run_once()
+        trials.append(time.perf_counter() - start)
+    tokens = max_new * len(prompts)
+    return (sorted(trials)[len(trials) // 2], tokens,
+            engine.prefix_hit_rate() if share else 0.0)
+
+
+def check_shared_parity(module, params, prompts, max_new) -> None:
+    """Every sharing-arm completion must be exactly generate()'s."""
+    engine = Engine(module, params, rows=ROWS, block_size=16,
+                    share_prefix=True)
+    scheduler = Scheduler(engine)
+    for index, prompt in enumerate(prompts):
+        scheduler.submit(Request(f's{index}', list(prompt), max_new))
+    results = scheduler.run()
+    for index, prompt in enumerate(prompts):
+        ref = generate(module, params, jnp.asarray(prompt)[None],
+                       steps=max_new)
+        expect = [int(t) for t in np.asarray(ref)[0, len(prompt):]]
+        got = list(results[f's{index}'].tokens)
+        assert got == expect, (index, got, expect)
+
+
+def shared_section() -> None:
+    module, params, prompts, max_new = shared_recipe()
+    check_shared_parity(module, params, prompts, max_new)
+    cold_seconds, tokens, _ = shared_arm(module, params, prompts, max_new,
+                                         share=False)
+    warm_seconds, _, hit_rate = shared_arm(module, params, prompts, max_new,
+                                           share=True)
+    cold_tok_s = tokens / cold_seconds
+    warm_tok_s = tokens / warm_seconds
+    workload = (f'{len(prompts)} reqs, shared prefix '
+                f'{len(prompts[0]) - 8}, suffix 8, max_new {max_new}, '
+                f'rows {ROWS}')
+    print(json.dumps({'metric': 'serve_prefix_hit_rate',
+                      'value': round(hit_rate, 3),
+                      'unit': 'shared/prompt tokens', 'workload': workload}))
+    print(json.dumps({
+        'metric': 'serve_shared_prefix_speedup',
+        'value': round(warm_tok_s / cold_tok_s, 2),
+        'unit': 'x delivered tok/s vs no-sharing'
+                + ('' if ON_TPU else ' [CPU smoke]'),
+        'shared_tok_s': round(warm_tok_s, 1),
+        'unshared_tok_s': round(cold_tok_s, 1),
+        'workload': workload}))
+
+
 def main() -> None:
+    if 'shared' in sys.argv[1:]:
+        shared_section()         # LAST line = serve_shared_prefix_speedup
+        return
+    shared_section()
     module, params, prompts, budgets = recipe()
     static_seconds, tokens = static_arm(module, params, prompts, budgets)
     continuous_seconds, _, phases = continuous_arm(module, params, prompts,

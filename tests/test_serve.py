@@ -95,8 +95,10 @@ def test_engine_gates_unsupported_modules(served):
     assert engine_unsupported_reason(gpt2_tiny()) is None
     assert 'scan_layers' in engine_unsupported_reason(
         gpt2_tiny(scan_layers=True))
-    assert 'MoE' in engine_unsupported_reason(
-        gpt2_tiny(moe_experts=2, moe_every=2))
+    # the MoE gate is LIFTED: decode dispatch runs full-capacity (no
+    # token drops => per-token independence), so MoE modules serve
+    assert engine_unsupported_reason(
+        gpt2_tiny(moe_experts=2, moe_every=2)) is None
     with pytest.raises(ValueError, match='scan_layers'):
         Engine(gpt2_tiny(scan_layers=True), params)
 
@@ -565,3 +567,293 @@ def test_serve_levers_pick_the_backend_default():
     levers = serve_levers()
     assert levers['stream_dtype'] == (
         'int8' if jax.default_backend() in ('tpu', 'axon') else 'auto')
+
+
+# ---------------------------------------------------------------------------
+# radix prefix sharing: refcounted blocks, token-exact adoption
+# ---------------------------------------------------------------------------
+
+
+class TestRadixPrefixSharing:
+    def test_refcounted_free_list_survives_interleaved_churn(self):
+        """Admit/retire with interleaved shared prefixes: blocks are
+        shared only between rows whose prompts actually share the
+        prefix, refcounts return to zero on retirement, and the pool's
+        accounting matches a from-scratch audit."""
+        pool = PagedKVCache(rows=4, blocks=32, block_size=4, max_seq=64,
+                            share_prefix=True)
+        head = list(range(1, 13))            # 3 full blocks
+        other = list(range(100, 112))        # a DIFFERENT 3-block prefix
+        pool.admit(0, tokens=14, prompt=head + [50, 51])
+        pool.admit(1, tokens=14, prompt=head + [60, 61])
+        pool.admit(2, tokens=14, prompt=other + [70, 71])
+        # rows 0/1 share exactly the 3 head blocks; row 2 shares nothing
+        assert pool.shared_tokens(0) == 0    # first arrival populated it
+        assert pool.shared_tokens(1) == 12
+        assert pool.shared_tokens(2) == 0
+        np.testing.assert_array_equal(pool.table[0, :3], pool.table[1, :3])
+        shared = set(pool.table[0, :3].tolist())
+        assert not shared & set(pool.table[2, :4].tolist())
+        # suffix blocks are PRIVATE even between the sharing rows
+        assert pool.table[0, 3] != pool.table[1, 3]
+        audit = pool.audit()
+        # churn: retire the first owner — the adopter keeps the blocks
+        pool.evict(0)
+        assert pool.shared_tokens(1) == 12
+        pool.admit(3, tokens=14, prompt=head + [80, 81])
+        assert pool.shared_tokens(3) == 12
+        np.testing.assert_array_equal(pool.table[1, :3], pool.table[3, :3])
+        for row in (1, 2, 3):
+            pool.evict(row)
+        # refcounts all back to zero: nothing live, accounting exact
+        audit = pool.audit()
+        assert audit['live'] == 0
+        assert audit['free'] + audit['cached'] == pool.blocks - 1
+        assert pool.free_blocks == pool.blocks - 1
+
+    def test_cached_blocks_are_reclaimed_lru_under_pressure(self):
+        pool = PagedKVCache(rows=2, blocks=8, block_size=4, max_seq=64,
+                            share_prefix=True)
+        pool.admit(0, tokens=10, prompt=list(range(1, 11)))   # 3 blocks
+        pool.evict(0)                        # 2 registered blocks go warm
+        assert pool.audit()['cached'] == 2
+        # a new admission needing every block reclaims the warm ones
+        pool.admit(1, tokens=28, prompt=list(range(50, 78)))  # 7 blocks
+        assert pool.audit()['cached'] == 0
+        pool.evict(1)
+
+    def test_engine_sharing_is_token_exact_and_counts_hits(self, served):
+        """Co-batched requests sharing a system prompt adopt its blocks
+        and stay token-exact vs standalone generate(); the retired
+        prefix is re-adopted warm by a later wave."""
+        module, params = served
+        rng = np.random.default_rng(43)
+        engine = Engine(module, params, rows=4, block_size=4, blocks=64,
+                        share_prefix=True)
+        scheduler = Scheduler(engine)
+        head = [int(t) for t in rng.integers(0, 256, (21,))]
+        prompts = [head + [int(t) for t in rng.integers(0, 256, (k,))]
+                   for k in (3, 4, 5, 2)]
+        for index, prompt in enumerate(prompts):
+            scheduler.submit(Request(f'r{index}', prompt, max_new=5))
+        results = scheduler.run()
+        for index, prompt in enumerate(prompts):
+            assert results[f'r{index}'].tokens == reference(
+                module, params, prompt, 5), f'r{index} diverged'
+        assert engine.sharing['prefix_hits'] == 3      # all but the first
+        assert engine.prefix_hit_rate() > 0.5
+        assert engine.trace_count == 1
+        # second wave: the whole prefix is warm in the radix tree
+        assert engine.prefix_cached_len(head + [9]) == 20   # (21-1)//4*4
+        scheduler.submit(Request('warm', head + [9, 9], max_new=4))
+        results = scheduler.run()
+        assert results['warm'].tokens == reference(
+            module, params, head + [9, 9], 4)
+
+    def test_sharing_row_tokens_independent_of_cobatched_traffic(self, served):
+        """The engine contract under sharing: a row's tokens equal its
+        solo run even when neighbors share (or don't share) its
+        prefix."""
+        module, params = served
+        rng = np.random.default_rng(47)
+        prompt = [int(t) for t in rng.integers(0, 256, (13,))]
+        solo_engine = Engine(module, params, rows=4, block_size=4,
+                             share_prefix=True)
+        solo_engine.admit(prompt, max_new=6)
+        solo = None
+        while solo_engine.active_rows:
+            for _row, _reason, out in solo_engine.step().finished:
+                solo = out
+        engine = Engine(module, params, rows=4, block_size=4,
+                        share_prefix=True)
+        engine.admit(prompt[:9] + [3, 1, 4, 1], max_new=6)   # partial share
+        admission = engine.admit(prompt, max_new=6)
+        engine.admit([int(t) for t in rng.integers(0, 256, (7,))], max_new=6)
+        tokens = {}
+        while engine.active_rows:
+            for row, _reason, out in engine.step().finished:
+                tokens[row] = out
+        assert tokens[admission.row] == solo
+
+
+# ---------------------------------------------------------------------------
+# fused decode_impl: the Pallas chain behind the paged step
+# ---------------------------------------------------------------------------
+
+
+class TestFusedDecodeImpl:
+    def test_fused_step_is_token_exact_vs_flax(self, served):
+        module, params = served
+        rng = np.random.default_rng(53)
+        prompts = [[int(t) for t in rng.integers(0, 256, (k,))]
+                   for k in (7, 5)]
+        engine = Engine(module, params, rows=2, block_size=8,
+                        decode_impl='fused')
+        for prompt in prompts:
+            engine.admit(prompt, max_new=6)
+        tokens = {}
+        while engine.active_rows:
+            for row, _reason, out in engine.step().finished:
+                tokens[row] = out
+        for row, prompt in enumerate(prompts):
+            assert tokens[row] == reference(module, params, prompt, 6)
+        assert engine.trace_count == 1
+
+    @pytest.mark.slow
+    def test_fused_step_composes_with_int8_streaming(self, served):
+        module, params = served
+        prompt = np.random.default_rng(59).integers(0, 256, (9,))
+        expected = reference(module, params, prompt, 8, stream_dtype='int8')
+        engine = Engine(module, params, rows=2, block_size=8,
+                        decode_impl='fused', stream_dtype='int8')
+        engine.admit(prompt, max_new=8)
+        tokens = None
+        while engine.active_rows:
+            for _row, _reason, out in engine.step().finished:
+                tokens = out
+        assert tokens == expected
+
+    def test_fused_refuses_unsupported_and_auto_falls_back(self, served):
+        module, params = served
+        probe = jnp.zeros((1, 8), jnp.int32)
+        moe = gpt2_tiny(dtype='float32', moe_experts=2, moe_every=2)
+        moe_params = moe.init(jax.random.PRNGKey(0), probe)['params']
+        with pytest.raises(ValueError, match='fused'):
+            Engine(moe, moe_params, rows=2, block_size=8,
+                   decode_impl='fused')
+        # 'auto' serves the same module through the flax step instead
+        engine = Engine(moe, moe_params, rows=2, block_size=8,
+                        decode_impl='auto')
+        assert engine.decode_impl == 'flax'
+        with pytest.raises(ValueError, match='decode_impl'):
+            Engine(module, params, rows=2, block_size=8,
+                   decode_impl='nonsense')
+
+
+# ---------------------------------------------------------------------------
+# the MoE gate, lifted: full-capacity decode dispatch
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_moe_engine_is_token_exact_under_cobatching():
+    """Serving an MoE module: full-capacity decode dispatch drops no
+    token, so each row's experts see it regardless of co-batched
+    neighbors — token-exact vs standalone generate()."""
+    module = gpt2_tiny(dtype='float32', moe_experts=2, moe_every=2)
+    rng = np.random.default_rng(61)
+    probe = jnp.asarray(rng.integers(0, 256, (1, 8)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), probe)['params']
+    engine = Engine(module, params, rows=3, block_size=4)
+    prompts = [[int(t) for t in rng.integers(0, 256, (k,))]
+               for k in (7, 5, 9)]
+    admissions = [engine.admit(p, max_new=6) for p in prompts]
+    tokens = {}
+    while engine.active_rows:
+        for row, _reason, out in engine.step().finished:
+            tokens[row] = out
+    for admission, prompt in zip(admissions, prompts):
+        assert tokens[admission.row] == reference(module, params, prompt, 6)
+    assert engine.trace_count == 1
+
+
+# ---------------------------------------------------------------------------
+# speculative rows: draft/verify riding the paged pool
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('fanout', [1, 2])
+def test_speculative_rows_match_target_greedy(served, fanout):
+    """Draft rows ride the paged pool as extra batch rows; the output is
+    exactly the target's greedy decode and the multi-token steps beat
+    one-token-per-step (fewer engine steps than tokens)."""
+    module, params = served
+    rng = np.random.default_rng(67)
+    engine = Engine(module, params, rows=4, block_size=4,
+                    draft_module=module, draft_params=params,
+                    speculate=3, tree_fanout=fanout)
+    prompts = [[int(t) for t in rng.integers(0, 256, (k,))]
+               for k in (7, 5)]
+    admissions = [engine.admit(p, max_new=8) for p in prompts]
+    tokens, steps = {}, 0
+    while engine.active_rows:
+        engine_report = engine.step()
+        steps += 1
+        for row, _reason, out in engine_report.finished:
+            tokens[row] = out
+    for admission, prompt in zip(admissions, prompts):
+        assert tokens[admission.row] == reference(module, params, prompt, 8)
+    # a self-draft accepts every token: 8 tokens in ceil(8/4) steps
+    assert steps < 8
+    assert engine.trace_count == 1
+    assert engine.pool.free_blocks == engine.pool.blocks - 1
+
+
+@pytest.mark.slow
+def test_speculative_rows_compose_with_sharing_through_scheduler(served):
+    module, params = served
+    rng = np.random.default_rng(71)
+    engine = Engine(module, params, rows=4, block_size=4,
+                    share_prefix=True, draft_module=module,
+                    draft_params=params, speculate=3, tree_fanout=2)
+    scheduler = Scheduler(engine)
+    head = [int(t) for t in rng.integers(0, 256, (13,))]
+    prompts = [head + [int(t) for t in rng.integers(0, 256, (k,))]
+               for k in (3, 2)]
+    for index, prompt in enumerate(prompts):
+        scheduler.submit(Request(f'r{index}', prompt, max_new=6))
+    results = scheduler.run()
+    for index, prompt in enumerate(prompts):
+        assert results[f'r{index}'].tokens == reference(
+            module, params, prompt, 6)
+    assert engine.sharing['prefix_hits'] >= 1
+
+
+def test_speculative_validates_budget_and_stop_token(served):
+    module, params = served
+    rng = np.random.default_rng(73)
+    engine = Engine(module, params, rows=2, block_size=8,
+                    draft_module=module, draft_params=params, speculate=3)
+    with pytest.raises(ValueError, match='speculate'):
+        # 8 + 117 + 3 + 1 > 128: the draft chain would overrun max_seq
+        engine.admit(list(rng.integers(0, 256, (8,))), max_new=117)
+    prompt = [int(t) for t in rng.integers(0, 256, (7,))]
+    expected = reference(module, params, prompt, 12)
+    stop = expected[3]
+    scheduler = Scheduler(engine)
+    scheduler.submit(Request('s', prompt, max_new=12, stop_token=stop))
+    results = scheduler.run()
+    assert results['s'].reason == 'stop'
+    assert results['s'].tokens == expected[:expected.index(stop) + 1]
+
+
+# ---------------------------------------------------------------------------
+# scheduler: suffix-only prefill budgeting
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_budget_counts_only_the_uncached_suffix(served):
+    """With sharing on, a second wave of shared-prefix requests costs
+    the budget only its uncached suffix buckets — so a budget that
+    admits ONE cold request a step admits the whole warm wave at once.
+    And a FULLY cached prompt still charges bucket(1): the one-
+    admission rule can't spin on zero-cost admissions."""
+    module, params = served
+    rng = np.random.default_rng(79)
+    engine = Engine(module, params, rows=4, block_size=16, blocks=64,
+                    share_prefix=True)
+    head = [int(t) for t in rng.integers(0, 256, (33,))]   # 2 full blocks
+    scheduler = Scheduler(engine, prefill_budget=64)
+    scheduler.submit(Request('cold', head + [1], max_new=3))
+    scheduler.run()                       # radix tree now holds the head
+    assert engine.prefix_cached_len(head + [2]) == 32
+    # cold cost: bucket(34) = 64 — one per step under this budget.
+    # warm cost: bucket(2) = 16 — four fit in one step's budget
+    for index in range(4):
+        scheduler.submit(Request(f'w{index}', head + [2 + index], max_new=3))
+    tick = scheduler.step()
+    assert len(tick.admitted) == 4, [r.id for r, _, _ in tick.admitted]
+    assert engine.admit_cost(head + [2]) == 16
+    assert engine.admit_cost(head) == 16  # fully cached still costs >0
+    scheduler.run()

@@ -61,7 +61,9 @@ def reference(module, params, prompt, steps):
 def build_for(module, params, **kwargs):
     knobs = dict(rows=2, block_size=8)
     knobs.update(kwargs)
-    engine_knobs = {k: knobs.pop(k) for k in ('rows', 'block_size', 'blocks')
+    engine_knobs = {k: knobs.pop(k)
+                    for k in ('rows', 'block_size', 'blocks', 'share_prefix',
+                              'decode_impl', 'stream_dtype')
                     if k in knobs}
     return lambda: Scheduler(Engine(module, params, **engine_knobs), **knobs)
 

@@ -246,6 +246,30 @@ class TestRouting:
         # each submission deepens a replica, so the next goes elsewhere
         assert sorted(names) == ['rep0', 'rep1', 'rep2']
 
+    def test_prefix_affinity_steers_to_the_warm_replica(self):
+        """A replica whose engine holds the prompt's prefix in its radix
+        tree wins placement over emptier-but-cold replicas — and loses
+        it again the moment it is backpressured (affinity is a steering
+        hint, never a pressure override)."""
+        clock = FakeClock()
+        router, handles, _ = fake_fleet(clock, n=3)
+
+        class _WarmEngine:
+            @staticmethod
+            def prefix_cached_len(prompt):
+                return 8 if list(prompt[:2]) == [1, 2] else 0
+
+        handles[2].scheduler.engine = _WarmEngine()
+        # depth tie everywhere: the warm radix tree breaks it
+        assert router.submit(Request('warm', [1, 2, 3, 4], 4)) == 'rep2'
+        # ... and keeps winning even when rep2 is now DEEPER than the rest
+        assert router.submit(Request('warm2', [1, 2, 3, 4], 4)) == 'rep2'
+        # a cold prompt ignores affinity: least-loaded as before
+        assert router.submit(Request('cold', [9, 9], 4)) in ('rep0', 'rep1')
+        # backpressure outranks the warm cache
+        handles[2].scheduler.backpressure = True
+        assert router.submit(Request('warm3', [1, 2, 3, 4], 4)) != 'rep2'
+
     def test_backpressured_replica_passed_over(self):
         clock = FakeClock()
         router, handles, _ = fake_fleet(clock, n=2)
@@ -716,11 +740,13 @@ def served():
 
 
 def real_fleet(module, params, clock, n=3, *, cadence=1, rows=2,
-               trace=False):
+               trace=False, **engine_knobs):
     """N supervised replicas over REAL engines, each journaling into its
     own supervisor-RAM MemStore (what a SIGKILL leaves behind). With
     ``trace=True`` every replica and the router carry a Tracer on the
-    shared clock; returns them as the 4th element (else Nones)."""
+    shared clock; returns them as the 4th element (else Nones). Extra
+    keywords (``share_prefix``, ``decode_impl``, ...) reach every
+    replica's Engine."""
     from tpusystem.observe import Tracer
     stores = [MemStore() for _ in range(n)]
     handles = []
@@ -731,8 +757,8 @@ def real_fleet(module, params, clock, n=3, *, cadence=1, rows=2,
 
         def build(i=i, tracer=tracer):
             return Scheduler(Engine(module, params, rows=rows,
-                                    block_size=8), clock=clock,
-                             tracer=tracer)
+                                    block_size=8, **engine_knobs),
+                             clock=clock, tracer=tracer)
         replica = ServingReplica(build, identity=f'rep{i}',
                                  client=stores[i], cadence=cadence,
                                  clock=clock)
@@ -847,6 +873,48 @@ class TestFleetChaosDrill:
                            if processes[event['pid']].startswith('rep')})
                    >= 2]
         assert crossed, 'no trace crossed engines after the handoff'
+
+    def test_preemption_wave_with_sharing_and_fused_on(self, served):
+        """The kill-a-replica drill with this PR's levers engaged:
+        ``share_prefix=True`` + ``decode_impl='fused'`` on every
+        replica, a shared-system-prompt workload, one replica killed
+        mid-stream. Replayed/rerouted rows re-prefill prompt + emitted
+        prefix through the radix tree (adopting whatever prefix the
+        survivor already holds) and every completion is token-exact vs
+        the uninterrupted fleet — the levers compose with journal
+        replay, they don't fork it."""
+        module, params = served
+        rng = np.random.default_rng(83)
+        head = rng.integers(0, 256, (12,)).tolist()
+        prompts = [head + rng.integers(0, 256, (k,)).tolist()
+                   for k in (5, 2, 4, 1, 3, 2, 5, 4, 3)]
+        budgets = [10, 8, 12, 6, 9, 11, 7, 12, 8]
+        clock = FakeClock()
+        levers = dict(share_prefix=True, decode_impl='fused')
+
+        reference_router, _, _, _ = real_fleet(module, params, clock, n=3,
+                                               **levers)
+        for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            reference_router.submit(Request(f'r{index}', prompt, budget))
+        reference = reference_router.run_until_idle()
+
+        router, handles, _, _ = real_fleet(module, params, clock, n=3,
+                                           **levers)
+        for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            router.submit(Request(f'r{index}', prompt, budget))
+        wave = PreemptionWave(step=2, kills=(handles[0].kill,))
+        saw_hot, saw_cold, _ = drive(router, wave, victims=(handles[0],))
+        assert wave.fired and not handles[0].healthy
+        assert saw_hot or saw_cold, 'the kill rerouted nothing'
+        assert set(router.results) == set(reference)
+        for rid, completion in router.results.items():
+            assert completion.tokens == reference[rid].tokens, rid
+            assert completion.reason == reference[rid].reason, rid
+        # the prefix blocks were ACTUALLY shared on the survivors, not
+        # just configured: the radix trees scored hits during the drain
+        hits = sum(handle.scheduler.engine.sharing['prefix_hits']
+                   for handle in handles[1:])
+        assert hits > 0, 'no survivor adopted a shared prefix'
 
     @pytest.mark.slow
     def test_double_kill_wave_with_buddy_journal(self, served):

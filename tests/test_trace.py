@@ -91,7 +91,11 @@ class FakeEngine:
     def bucket(self, n: int) -> int:
         return n
 
-    def can_admit(self, prompt_len: int, remaining: int) -> bool:
+    def admit_cost(self, prompt) -> int:
+        return self.bucket(len(prompt))
+
+    def can_admit(self, prompt_len: int, remaining: int,
+                  prompt=None) -> bool:
         return len(self.active) < self.rows
 
     def admit(self, prompt, remaining, stop_token=None, tag=None):
@@ -106,7 +110,7 @@ class FakeEngine:
         emitted, finished = {}, []
         for row, state in list(self.active.items()):
             state[0] += 1
-            emitted[row] = state[2] + state[0]
+            emitted[row] = [state[2] + state[0]]
             if state[0] >= state[1]:
                 del self.active[row]
                 tokens = [state[2] + k for k in range(1, state[0] + 1)]

@@ -147,6 +147,10 @@ class Block(nn.Module):
             # the schedule's moe= arm reaches the expert dispatch here:
             # with moe='overlap' the sharded quota exchange pipelines its
             # all_to_all under the expert matmuls (ops/moe.py)
+            # decode dispatches at FULL capacity: no drops, so a token's
+            # expert mix is independent of co-batched traffic and of the
+            # serving engine's pad buckets — the engine's token-exactness
+            # contract (training keeps the capacity_factor economics)
             shrunk, aux = MoEMLP(self.moe_experts, k=self.moe_k,
                                  mlp_ratio=self.mlp_ratio,
                                  capacity_factor=self.moe_capacity_factor,
@@ -154,6 +158,7 @@ class Block(nn.Module):
                                  exchange=self.moe_exchange,
                                  sparse_impl=self.moe_sparse_impl,
                                  schedule=schedule,
+                                 full_capacity=self.decode,
                                  name='moe')(normed.astype(self.dtype))
         else:
             from tpusystem.parallel.overlap import DenseParams

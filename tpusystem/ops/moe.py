@@ -505,6 +505,16 @@ class MoEMLP(nn.Module):
     # parity with gather/scatter is NOT expected, only tolerance-bounded:
     # the MXU accumulates in f32 and sums in different orders)
     sparse_impl: str = 'gather'
+    # full_capacity: seat EVERY assignment — capacity = tokens, the
+    # ample-capacity operating point made unconditional. With no drops
+    # each token's expert mix depends only on that token, so outputs are
+    # independent of co-batched traffic and of pad-bucket width — the
+    # property the serving engine's shared-batch decode step needs for
+    # token-exactness (and what lifts its MoE gate). Decode clones set
+    # it (models.gpt2.Block passes full_capacity=decode); training keeps
+    # the capacity_factor economics. Governs the single-shard paths —
+    # decode clones reset mesh=None, so decode always lands there.
+    full_capacity: bool = False
     # schedule: parallel.OverlapSchedule — its moe= arm governs the
     # sharded quota dispatch. moe='overlap' splits the local token rows
     # into microbatch pieces and software-pipelines the exchange: piece
@@ -595,8 +605,9 @@ class MoEMLP(nn.Module):
 
         logits = flat.astype(jnp.float32) @ router
         gates = jax.nn.softmax(logits)
-        capacity = expert_capacity(tokens, self.experts, self.k,
-                                   self.capacity_factor)
+        capacity = (tokens if self.full_capacity
+                    else expert_capacity(tokens, self.experts, self.k,
+                                         self.capacity_factor))
 
         if mode == 'sparse':
             token_ids, slots, weights, fraction = route_top_k_sparse(

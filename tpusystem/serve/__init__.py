@@ -12,10 +12,13 @@ the PR-7 weight-streaming levers.
 Layers, bottom up:
 
 * :class:`PagedKVCache` (+ ``adopt_prefill`` / ``write_tables``) — the
-  block pool free-list and per-sequence block tables
+  block pool free-list and per-sequence block tables, with optional
+  refcounted radix prefix sharing (``share_prefix=True``)
   (:mod:`tpusystem.serve.kvcache`);
 * :class:`Engine` — the fixed-shape compiled decode step with
-  admit/evict row churn (:mod:`tpusystem.serve.engine`);
+  admit/evict row churn; ``decode_impl='fused'`` routes it through the
+  Pallas fused decode chain and ``draft_module=`` turns rows into
+  speculative draft/verify groups (:mod:`tpusystem.serve.engine`);
 * :class:`Scheduler` / :class:`Request` — prefill/decode phase packing
   under a token budget (:mod:`tpusystem.serve.scheduler`);
 * :class:`InferenceService` — the command/event bus front door

@@ -24,10 +24,35 @@ of co-batched traffic, in window-length-invariant arithmetic (f32
 modules; masked attention positions contribute exact zeros, so a row
 never observes its neighbors — pinned by ``tests/test_serve.py``).
 
-``stream_dtype`` applies :func:`generate`'s weight-streaming levers to
-the engine's param tree ('int8' halves the per-step streamed weight
-bytes vs bf16; dequantization stays inside the compiled step so the
-narrow leaves remain the HBM-resident operand).
+The decode-roofline levers compose on top of that contract:
+
+* ``stream_dtype`` applies :func:`generate`'s weight-streaming levers to
+  the engine's param tree ('int8' halves the per-step streamed weight
+  bytes vs bf16; dequantization stays inside the compiled step so the
+  narrow leaves remain the HBM-resident operand).
+* ``decode_impl='fused'`` routes the one jitted step through the Pallas
+  fused decode chain
+  (:func:`tpusystem.train.decode_fused.build_fused_paged_step` — the
+  ``[rows, dim]`` activation VMEM-resident, the fc→gelu→proj pair one
+  kernel, int8/fp8 tiles dequantized in-kernel), gated by
+  :func:`tpusystem.train.decode_fused.fused_paged_reason` and
+  token-exact vs the flax step.
+* ``share_prefix=True`` turns on the radix prefix index
+  (:class:`tpusystem.serve.kvcache.PagedKVCache`): admissions whose
+  prompt starts with an already-cached block-aligned prefix adopt those
+  blocks by reference and prefill **only the uncached suffix** (the
+  resume prefill seeds a contiguous cache from pool gathers and applies
+  the suffix down the decode path — window-invariant, so tokens don't
+  move).
+* ``draft_module`` switches the step to **speculative rows**: each
+  request owns ``tree_fanout`` adjacent branch rows of the same paged
+  pool; the draft fans/extends each branch ``speculate`` tokens and ONE
+  target forward verifies every branch window, emitting the longest
+  target-greedy-accepted prefix plus one corrected token per request —
+  between 1 and ``speculate + 1`` tokens per step, still exactly the
+  target's greedy decode. Losing branches' blocks never leave the pool
+  accounting: block membership is fixed per request; the winner's
+  verify window is copied across siblings inside the step.
 """
 
 from __future__ import annotations
@@ -40,9 +65,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpusystem.serve.kvcache import (PagedKVCache, adopt_prefill,
+from tpusystem.serve.kvcache import (PagedKVCache, _is_kv, adopt_prefill,
                                      write_tables)
-from tpusystem.train.cursors import read_cursor, rewind
+from tpusystem.train.cursors import gather_rows, is_cursor, read_cursor, rewind
+from tpusystem.train.decode_fused import (build_fused_paged_step,
+                                          fused_paged_reason)
 from tpusystem.train.generate import _decoder, _dequant, _stream_params
 
 
@@ -53,7 +80,14 @@ class Saturated(RuntimeError):
 
 def engine_unsupported_reason(module) -> str | None:
     """None when the paged engine can serve this module, else why not
-    (the ``fused_unsupported_reason`` capability-gate discipline)."""
+    (the ``fused_unsupported_reason`` capability-gate discipline).
+
+    Served today: both family LMs (GPT2 / Llama, unrolled), including
+    **MoE** stacks — decode-mode expert dispatch runs at full capacity
+    (capacity = the step's token count, so routing never drops a token
+    and each token's expert mix is independent of co-batched traffic;
+    :class:`tpusystem.ops.moe.MoEMLP` ``full_capacity``). The remaining
+    gate is layout, not architecture."""
     for field in ('decode', 'max_seq', 'per_row_decode', 'decode_pages'):
         if not hasattr(module, field):
             return (f'module {type(module).__name__} has no {field!r} '
@@ -63,10 +97,6 @@ def engine_unsupported_reason(module) -> str | None:
         return ('scan_layers stacks the per-layer caches at a leading '
                 'layer dim; the engine admission writes are unrolled-'
                 'layout only — serve the unrolled module')
-    if getattr(module, 'moe_experts', 0):
-        return ('MoE expert capacity derives from the step\'s batch '
-                'token count, so a shared-batch decode step is not '
-                'token-exact against per-request decode')
     return None
 
 
@@ -106,6 +136,109 @@ def _build_prefill(decoder, bucket: int):
     return run
 
 
+@functools.cache
+def _compiled_resume(decoder, bucket: int):
+    return _build_resume(decoder, bucket)
+
+
+def _build_resume(decoder, bucket: int):
+    """The shared-prefix **resume prefill**: seed a contiguous decode
+    cache with the row's already-cached prefix KV (gathered from the
+    paged pool through the row's slot map) and its cursors at the cached
+    depth, then apply only the padded SUFFIX — ``cached_attention``
+    takes its decode path (the cache variables pre-exist), whose
+    bucketed masked read equals the full causal prefill in
+    window-length-invariant arithmetic, so the suffix logits — and the
+    request's first token — are exactly the full prefill's. One program
+    per suffix pad bucket. (Caveat, documented in docs/serving.md:
+    prompts whose FULL prefill would route the flash kernel — length >=
+    512 — mix flash-era prefix KV with the einsum decode read, exact
+    only up to the platform's near-tie argmax tolerance.)"""
+    del bucket          # part of the cache key; shapes key the jit cache
+    shapes = jax.eval_shape(
+        functools.partial(decoder.init, jax.random.PRNGKey(0)),
+        jnp.zeros((1, 1), jnp.int32))['cache']
+
+    @jax.jit
+    def run(params, cache, slots, padded, cached_len, suffix_len):
+        source = {jax.tree_util.keystr(path): leaf for path, leaf
+                  in jax.tree_util.tree_leaves_with_path(cache)}
+        keep = jnp.arange(decoder.max_seq) < cached_len
+
+        def seed(path, leaf):
+            if _is_kv(path):
+                pool = source[jax.tree_util.keystr(path)]
+                strip = jnp.take(pool, slots, axis=0)    # [max_seq, h, d]
+                strip = jnp.where(keep[:, None, None], strip, 0)
+                return strip[None].astype(leaf.dtype)
+            if is_cursor(path):
+                return jnp.full(leaf.shape, cached_len, leaf.dtype)
+            return jnp.zeros(leaf.shape, leaf.dtype)
+
+        resumed = jax.tree_util.tree_map_with_path(seed, shapes)
+        logits, state = decoder.apply(
+            {'params': _dequant(params, decoder), 'cache': resumed},
+            padded, mutable=['cache'])
+        first = jnp.argmax(logits[0, suffix_len - 1],
+                           axis=-1).astype(jnp.int32)
+        return first, state['cache']
+
+    return run
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _adopt_draft_rows(dcache, prefill_cache, rows, length):
+    """Seat a draft prefill strip in ``rows`` of the contiguous per-row
+    draft cache (every branch row of one speculative group gets the same
+    prompt KV): KV leaves overwrite whole row strips, cursor leaves set
+    to the prompt length. Fixed shapes — one compiled program."""
+    source = {jax.tree_util.keystr(path): leaf for path, leaf
+              in jax.tree_util.tree_leaves_with_path(prefill_cache)}
+
+    def fix(path, leaf):
+        if _is_kv(path):
+            strip = source[jax.tree_util.keystr(path)]   # [1, S, h, d]
+            wide = jnp.broadcast_to(strip,
+                                    (rows.shape[0],) + strip.shape[1:])
+            return leaf.at[rows].set(wide.astype(leaf.dtype))
+        if is_cursor(path):
+            return leaf.at[rows].set(jnp.asarray(length, leaf.dtype))
+        return leaf
+    return jax.tree_util.tree_map_with_path(fix, dcache)
+
+
+def _copy_winner_windows(cache, win_rows_wide, cursor, speculate: int,
+                         block: int, max_blocks: int):
+    """Token-tree verify's winner-copy, paged-pool flavored: every
+    branch row's verify window (positions ``cursor .. cursor +
+    speculate``, all past the shared prompt region) is overwritten from
+    its group winner's window — a pool gather + scatter through each
+    row's OWN block table, so losers' private decode blocks inherit the
+    winning branch's KV and block membership never changes (no free-list
+    traffic inside the step). Past-allocation positions map to trash on
+    both sides (dead copies)."""
+    positions = cursor[:, None] + jnp.arange(speculate + 1)[None, :]
+    logical = jnp.minimum(positions // block, max_blocks - 1)
+
+    def walk(node):
+        if isinstance(node, dict) and 'table' in node and 'key' in node:
+            table = node['table']
+            dst_phys = jnp.take_along_axis(table, logical, axis=1)
+            src_phys = jnp.take_along_axis(
+                jnp.take(table, win_rows_wide, axis=0), logical, axis=1)
+            dst = (dst_phys * block + positions % block).reshape(-1)
+            src = (src_phys * block + positions % block).reshape(-1)
+            out = dict(node)
+            for name in ('key', 'value'):
+                pool = node[name]
+                out[name] = pool.at[dst].set(jnp.take(pool, src, axis=0))
+            return out
+        if isinstance(node, dict):
+            return {name: walk(child) for name, child in node.items()}
+        return node
+    return walk(cache)
+
+
 @dataclasses.dataclass
 class Admission:
     """What :meth:`Engine.admit` hands back: the row the request landed
@@ -119,11 +252,12 @@ class Admission:
 
 @dataclasses.dataclass
 class StepReport:
-    """One engine step: ``emitted`` maps row -> new token for every row
-    that was active, ``finished`` lists the rows retired this step —
-    ``(row, reason, tokens)`` triples, already evicted by the time the
-    report returns (the tokens ride out with the report because eviction
-    frees the row's state)."""
+    """One engine step: ``emitted`` maps row -> the LIST of new tokens
+    for every row that was active (one token on the plain step; up to
+    ``speculate + 1`` on a speculative step), ``finished`` lists the
+    rows retired this step — ``(row, reason, tokens)`` triples, already
+    evicted by the time the report returns (the tokens ride out with the
+    report because eviction frees the row's state)."""
     emitted: dict
     finished: list                   # [(row, reason, tokens), ...]
 
@@ -140,8 +274,9 @@ class Engine:
     """The continuous-batching engine over one model's param tree.
 
     Args:
-        module: a family LM module (GPT2 / Llama conventions; see
-            :func:`engine_unsupported_reason` for the scope gate).
+        module: a family LM module (GPT2 / Llama conventions, MoE
+            included; see :func:`engine_unsupported_reason` for the
+            scope gate).
         params: trained parameters.
         rows: fixed decode batch width — the compiled step's shape.
         block_size: tokens per KV block.
@@ -152,6 +287,22 @@ class Engine:
         stream_dtype: :func:`tpusystem.train.generate.generate`'s
             weight-streaming lever, applied to the engine's param tree
             ('int8' for the serving default on HBM-bound chips).
+        decode_impl: ``'flax'`` | ``'fused'`` | ``'auto'`` — the step
+            implementation. ``'fused'`` is the Pallas fused paged step
+            (module docstring; raises where
+            :func:`tpusystem.train.decode_fused.fused_paged_reason`
+            names a gate); ``'auto'`` picks fused on TPU-class backends
+            when supported, flax otherwise.
+        share_prefix: enable the radix prefix index — co-batched (and
+            successive) requests sharing a prompt prefix share KV blocks
+            and prefill only their uncached suffix.
+        draft_module / draft_params: a cheap draft LM switches the step
+            to speculative rows (module docstring). Greedy only;
+            ``decode_impl='fused'`` does not compose (the verify forward
+            is the flax paged step).
+        speculate: draft tokens proposed per speculative step.
+        tree_fanout: branch rows per request (token-tree verify);
+            ``rows`` must be a multiple.
 
     The decode step traces exactly once per engine (``trace_count`` is
     the witness); admissions and evictions are host-side table edits
@@ -160,7 +311,10 @@ class Engine:
 
     def __init__(self, module, params, *, rows: int = 4,
                  block_size: int = 16, blocks: int | None = None,
-                 stream_dtype: str = 'auto') -> None:
+                 stream_dtype: str = 'auto', decode_impl: str = 'auto',
+                 share_prefix: bool = False, draft_module=None,
+                 draft_params=None, speculate: int = 4,
+                 tree_fanout: int = 1) -> None:
         reason = engine_unsupported_reason(module)
         if reason is not None:
             raise ValueError(f'the serving engine cannot run this module: '
@@ -170,18 +324,39 @@ class Engine:
         if blocks is None:
             blocks = rows * (self.max_seq // block_size) + 1
         self.stream_dtype = stream_dtype
+        self.share_prefix = share_prefix
+        self.speculate, self.tree_fanout = speculate, tree_fanout
+        self._spec = draft_module is not None
+        if self._spec:
+            if speculate < 1:
+                raise ValueError(f'speculate must be >= 1, got {speculate}')
+            if tree_fanout < 1:
+                raise ValueError(
+                    f'tree_fanout must be >= 1, got {tree_fanout}')
+            if tree_fanout > draft_module.vocab_size:
+                raise ValueError(f'tree_fanout ({tree_fanout}) exceeds the '
+                                 f'draft vocab ({draft_module.vocab_size})')
+            if rows % tree_fanout:
+                raise ValueError(f'rows ({rows}) must be a multiple of '
+                                 f'tree_fanout ({tree_fanout}) — each '
+                                 'request owns fanout adjacent branch rows')
         self._prefiller = _decoder(module)     # contiguous, shared-cursor
         self._decoder = dataclasses.replace(
             _decoder(module, per_row=True),
             decode_pages=(blocks, block_size))
         self._params = _stream_params(self._decoder, params, stream_dtype)
-        self.pool = PagedKVCache(rows, blocks, block_size, self.max_seq)
+        self.decode_impl = self._resolve_decode_impl(decode_impl)
+        self.pool = PagedKVCache(rows, blocks, block_size, self.max_seq,
+                                 share_prefix=share_prefix)
         shapes = jax.eval_shape(
             functools.partial(self._decoder.init, jax.random.PRNGKey(0)),
             jnp.zeros((rows, 1), jnp.int32))['cache']
         self._cache = jax.tree.map(
             lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), shapes)
-        self._free_rows = list(range(rows - 1, -1, -1))
+        # free seats: representative rows — every row when linear, the
+        # first row of each fanout-wide adjacent group when speculative
+        stride = self.tree_fanout if self._spec else 1
+        self._free_rows = list(range(rows - stride, -1, -stride))
         # host mirrors for bookkeeping; the device copies are what the
         # step consumes (tokens feed back device-to-device — the per-
         # step host round trip is ONLY the emitted-token read)
@@ -190,9 +365,15 @@ class Engine:
         self._tokens_dev = jnp.zeros(rows, jnp.int32)
         self._active_dev = jnp.zeros(rows, bool)
         self._rowstate: dict[int, _RowState] = {}
-        self._prefills: dict[int, object] = {}   # unhashable-module path
+        self._prefills: dict[object, object] = {}  # unhashable-module path
+        self._resumes: dict[int, object] = {}
         self.trace_count = 0
         self.timings = {'prefill': 0.0, 'admit': 0.0, 'step': 0.0}
+        # prefix-sharing effectiveness counters (the bench's
+        # prefix_hit_rate reads these)
+        self.sharing = {'admissions': 0, 'prefix_hits': 0,
+                        'prompt_tokens': 0, 'shared_tokens': 0,
+                        'resumed_prefills': 0}
         # wall seconds of the most recent decode dispatch (admission and
         # prefill excluded) — the decode-only probe for a custom serving
         # loop that wants to feed failover.StepWatchdog.observe the step
@@ -200,20 +381,164 @@ class Engine:
         # tick on its injectable clock instead)
         self.last_step_seconds = 0.0
 
-        def step_fn(params, cache, tokens, active):
-            self.trace_count += 1            # runs at trace time only
-            logits, updated = self._decoder.apply(
-                {'params': _dequant(params, self._decoder), 'cache': cache},
-                tokens[:, None], mutable=['cache'])
-            token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            # park retired rows' cursors at 0 so their dead writes stay
-            # in the trash block's first slots instead of walking off the
-            # table; active rows keep the cursor cached_attention advanced
-            cursor = read_cursor(cache)
-            return token, rewind(updated['cache'],
-                                 jnp.where(active, cursor + 1, 0))
+        if self._spec:
+            self._drafter = _decoder(draft_module, per_row=True)
+            self._draft_prefiller = _decoder(draft_module)
+            self._dparams = _stream_params(self._drafter, draft_params,
+                                           stream_dtype)
+            dshapes = jax.eval_shape(
+                functools.partial(self._drafter.init,
+                                  jax.random.PRNGKey(0)),
+                jnp.zeros((rows, 1), jnp.int32))['cache']
+            self._dcache = jax.tree.map(
+                lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), dshapes)
+            self._spec_step = jax.jit(self._build_spec_step(),
+                                      donate_argnums=(2, 3))
+            self._step = None
+            return
+
+        if self.decode_impl == 'fused':
+            fused = build_fused_paged_step(self._decoder)
+
+            def step_fn(params, cache, tokens, active):
+                self.trace_count += 1        # runs at trace time only
+                logits, updated = fused(params, cache, tokens)
+                token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                cursor = read_cursor(cache)
+                return token, rewind(updated,
+                                     jnp.where(active, cursor + 1, 0))
+        else:
+            def step_fn(params, cache, tokens, active):
+                self.trace_count += 1        # runs at trace time only
+                logits, updated = self._decoder.apply(
+                    {'params': _dequant(params, self._decoder),
+                     'cache': cache},
+                    tokens[:, None], mutable=['cache'])
+                token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                # park retired rows' cursors at 0 so their dead writes
+                # stay in the trash block's first slots instead of
+                # walking off the table; active rows keep the cursor
+                # cached_attention advanced
+                cursor = read_cursor(cache)
+                return token, rewind(updated['cache'],
+                                     jnp.where(active, cursor + 1, 0))
 
         self._step = jax.jit(step_fn, donate_argnums=(1,))
+
+    def _resolve_decode_impl(self, decode_impl: str) -> str:
+        if decode_impl not in ('auto', 'flax', 'fused'):
+            raise ValueError(f"decode_impl must be 'auto', 'flax' or "
+                             f"'fused', got {decode_impl!r}")
+        if decode_impl == 'flax':
+            return 'flax'
+        reason = fused_paged_reason(self._decoder)
+        if decode_impl == 'fused':
+            if self._spec:
+                raise ValueError(
+                    "decode_impl='fused' does not compose with "
+                    'speculative rows — the tree-verify forward is the '
+                    'flax paged step (fused composes with share_prefix '
+                    'and int8/fp8 streaming)')
+            if reason is not None:
+                raise ValueError(f"decode_impl='fused' unsupported: "
+                                 f'{reason}')
+            return 'fused'
+        if self._spec or reason is not None:
+            return 'flax'
+        return ('fused' if jax.default_backend() in ('tpu', 'axon')
+                else 'flax')
+
+    # ---------------------------------------------------------- speculative
+
+    def _build_spec_step(self):
+        """The speculative-rows step: K+1 fanning draft steps on the
+        contiguous per-row draft cache, ONE flax paged verify forward
+        over every branch's ``[K+1]`` window, winner selection per
+        adjacent fanout group, in-pool winner-window copy, and both
+        caches rewound to the accepted depth. Emits ``[groups, K+1]``
+        tokens (accepted prefix + correction, zero-padded) plus the
+        per-group acceptance count."""
+        decoder, drafter = self._decoder, self._drafter
+        K, F = self.speculate, self.tree_fanout
+        rows, groups = self.rows, self.rows // self.tree_fanout
+        block = self.block_size
+        max_blocks = self.max_seq // block
+        branch = jnp.arange(rows) % F
+
+        def spec_step(params, dparams, cache, dcache, tokens, active):
+            self.trace_count += 1            # runs at trace time only
+            cursor0 = read_cursor(cache)
+
+            def draft_step(state, step_index):
+                dc, tok = state
+                logits, updated = drafter.apply(
+                    {'params': _dequant(dparams, drafter), 'cache': dc},
+                    tok[:, None], mutable=['cache'])
+                logits = logits[:, -1]
+                # step 0 fans the tree out: sibling rows see identical
+                # logits, branch f takes the f-th most probable token;
+                # later steps continue each branch greedily
+                _, top = jax.lax.top_k(logits, F)
+                fanned = jnp.take_along_axis(
+                    top, branch[:, None], axis=1)[:, 0]
+                greedy = jnp.argmax(logits, axis=-1)
+                nxt = jnp.where(step_index == 0, fanned,
+                                greedy).astype(jnp.int32)
+                return (updated['cache'], nxt), nxt
+
+            # K+1 draft steps (not K): a fully accepted winner's draft
+            # cache must already hold d_K's KV for the next round
+            (dcache, _), drafts = jax.lax.scan(
+                draft_step, (dcache, tokens), jnp.arange(K + 1))
+            drafts = jnp.moveaxis(drafts, 0, 1)[:, :K]   # [rows, K]
+
+            # one target forward verifies every branch of every request
+            window = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            vlogits, tupdated = decoder.apply(
+                {'params': _dequant(params, decoder), 'cache': cache},
+                window, mutable=['cache'])
+            candidates = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+            matches = (drafts == candidates[:, :K]).astype(jnp.int32)
+            accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
+
+            # the longest accepted prefix wins its group; argmax ties
+            # resolve to the lowest branch id = the draft's most
+            # probable branch
+            per_group = accepted.reshape(groups, F)
+            winner = jnp.argmax(per_group, axis=1).astype(jnp.int32)
+            accepted_w = jnp.max(per_group, axis=1)      # [G]
+            win_rows = jnp.arange(groups) * F + winner
+            drafts_w = jnp.take(drafts, win_rows, axis=0)
+            correction = jnp.take_along_axis(
+                jnp.take(candidates, win_rows, axis=0),
+                accepted_w[:, None], axis=1)[:, 0]
+            positions = jnp.arange(K + 1)[None, :]
+            emitted = jnp.where(
+                positions < accepted_w[:, None],
+                jnp.pad(drafts_w, ((0, 0), (0, 1))),
+                jnp.where(positions == accepted_w[:, None],
+                          correction[:, None], 0))       # [G, K+1]
+            next_token = jnp.take_along_axis(
+                emitted, accepted_w[:, None], axis=1)[:, 0]
+
+            advance = jnp.where(active[::F], accepted_w + 1, 0)
+            new_cursor = jnp.where(active,
+                                   cursor0 + jnp.repeat(advance, F), 0)
+            tcache = tupdated['cache']
+            rowmap = jnp.repeat(win_rows, F)
+            if F > 1:
+                # losing branches inherit the winner's verify window
+                # through their OWN tables (private decode blocks; block
+                # membership is fixed — no in-step free-list traffic)
+                tcache = _copy_winner_windows(tcache, rowmap, cursor0, K,
+                                              block, max_blocks)
+            tcache = rewind(tcache, new_cursor)
+            dcache = rewind(gather_rows(dcache, rowmap), new_cursor)
+            wide_next = jnp.repeat(next_token, F)
+            new_tokens = jnp.where(active, wide_next, tokens)
+            return emitted, accepted_w, new_tokens, tcache, dcache
+
+        return spec_step
 
     # ------------------------------------------------------------ admission
 
@@ -225,16 +550,96 @@ class Engine:
     def active_rows(self) -> int:
         return int(self._active.sum())
 
-    def can_admit(self, prompt_len: int, max_new: int) -> bool:
-        return (bool(self._free_rows)
-                and self.pool.can_admit(prompt_len + max_new))
+    def can_admit(self, prompt_len: int, max_new: int,
+                  prompt=None) -> bool:
+        """Whether an admission of this shape would seat right now.
+        Pass the prompt tokens to account for prefix sharing (matched
+        blocks don't need allocating); without them the estimate is
+        conservative. Optimism is safe either way — :meth:`admit` rolls
+        a mid-flight shortfall back into :class:`Saturated`."""
+        if not self._free_rows:
+            return False
+        tokens = prompt_len + max_new
+        needed = self.pool.blocks_for(tokens)
+        if needed > self.pool.max_blocks:
+            return False
+        fanout = self.tree_fanout if self._spec else 1
+        if self.share_prefix and prompt is not None:
+            matched = (self.pool.adoptable_prefix(prompt)[0]
+                       // self.block_size)
+            # later branches also match the blocks the first branch
+            # registers (every fully-prompt-covered block)
+            sibling = max(matched, (prompt_len - 1) // self.block_size)
+            total = (needed - matched) + (fanout - 1) * (needed - sibling)
+        else:
+            total = fanout * needed
+        return total <= self.pool.free_blocks
 
     def bucket(self, prompt_len: int) -> int:
         return prefill_bucket(prompt_len, self.block_size, self.max_seq)
 
+    def prefix_cached_len(self, prompt) -> int:
+        """How many leading prompt tokens the radix index would serve
+        from cache if this prompt were admitted now (0 without
+        sharing) — the scheduler's suffix-budget and the router's
+        prefix-affinity probe."""
+        if not self.share_prefix:
+            return 0
+        return self.pool.adoptable_prefix(prompt)[0]
+
+    def admit_cost(self, prompt) -> int:
+        """Prefill pad-bucket cost of admitting ``prompt``: the bucket
+        of its UNCACHED suffix under prefix sharing, of the whole prompt
+        otherwise. Never zero — a fully-cached prompt still prefills at
+        least one token (its first-token logits), so suffix-budgeted
+        admission can't spin on free admissions."""
+        suffix = max(len(prompt) - self.prefix_cached_len(prompt), 1)
+        return self.bucket(suffix)
+
+    def _run_prefill(self, decoder, bucket: int, padded, length: int):
+        try:
+            run = _compiled_prefill(decoder, bucket)
+        except TypeError:        # unhashable module field (e.g. live mesh)
+            run = self._prefills.setdefault(
+                (decoder is self._prefiller, bucket),
+                _build_prefill(decoder, bucket))
+        return run(self._params if decoder is self._prefiller
+                   else self._dparams, jnp.asarray(padded), length)
+
+    def _prefill_rows(self, prompt, rows: list[int]):
+        """Target prefill for an admission already seated in the pool:
+        the resume program over the uncached suffix when the first row
+        adopted a shareable prefix (and the suffix window fits), the
+        plain full-prompt program otherwise. Returns the first token and
+        the contiguous strip to adopt (valid at every prompt position at
+        or past each row's own shared depth)."""
+        shared = self.pool.shared_tokens(rows[0])
+        suffix = prompt.size - shared
+        if shared and shared + self.bucket(suffix) <= self.max_seq:
+            sbucket = self.bucket(suffix)
+            padded = np.zeros((1, sbucket), np.int32)
+            padded[0, :suffix] = prompt[shared:]
+            try:
+                run = _compiled_resume(self._prefiller, sbucket)
+            except TypeError:    # unhashable module field (e.g. live mesh)
+                run = self._resumes.setdefault(
+                    sbucket, _build_resume(self._prefiller, sbucket))
+            first, prefill_cache = run(
+                self._params, self._cache,
+                jnp.asarray(self.pool.slots(rows[0])),
+                jnp.asarray(padded), shared, suffix)
+            self.sharing['resumed_prefills'] += 1
+            return first, prefill_cache
+        bucket = self.bucket(prompt.size)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :prompt.size] = prompt
+        return self._run_prefill(self._prefiller, bucket, padded,
+                                 prompt.size)
+
     def admit(self, prompt, max_new: int, *, stop_token: int | None = None,
               tag=None) -> Admission:
-        """Prefill ``prompt`` and seat it in a free row. Raises
+        """Prefill ``prompt`` and seat it in a free row (a free GROUP of
+        ``tree_fanout`` adjacent rows when speculative). Raises
         :class:`Saturated` when no row or not enough blocks are free
         (the scheduler queues on this), ``ValueError`` on requests that
         could never fit."""
@@ -247,46 +652,89 @@ class Engine:
             raise ValueError(
                 f'prompt ({prompt.size}) + max_new ({max_new}) exceeds the '
                 f'cache capacity max_seq={self.max_seq}')
+        if self._spec:
+            needed = prompt.size + max_new + self.speculate + 1
+            if needed > self._drafter.max_seq:
+                raise ValueError(
+                    f'prompt + max_new + speculate + 1 = {needed} exceeds '
+                    f'the draft cache capacity max_seq='
+                    f'{self._drafter.max_seq} (the draft overshoots by up '
+                    'to speculate tokens before rewinding)')
         if not self._free_rows:
             raise Saturated('no free row')
-        if not self.pool.can_admit(prompt.size + max_new):
+        if not self.can_admit(prompt.size, max_new, prompt=prompt):
             raise Saturated(
                 f'{self.pool.blocks_for(prompt.size + max_new)} blocks '
-                f'needed, {self.pool.free_blocks} free')
+                f'needed per row, {self.pool.free_blocks} free')
 
-        bucket = self.bucket(prompt.size)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :prompt.size] = prompt
-        started = time.perf_counter()
+        fanout = self.tree_fanout if self._spec else 1
+        rep = self._free_rows.pop()
+        rows = list(range(rep, rep + fanout))
+        tokens = prompt.size + max_new
+        seated = []
         try:
-            run = _compiled_prefill(self._prefiller, bucket)
-        except TypeError:        # unhashable module field (e.g. live mesh)
-            run = self._prefills.setdefault(
-                bucket, _build_prefill(self._prefiller, bucket))
-        first, prefill_cache = run(self._params, jnp.asarray(padded),
-                                   prompt.size)
+            for row in rows:
+                self.pool.admit(row, tokens,
+                                prompt=prompt if self.share_prefix
+                                else None)
+                seated.append(row)
+        except ValueError:
+            for row in seated:
+                self.pool.evict(row)
+            self._free_rows.append(rep)
+            raise Saturated(
+                f'{self.pool.blocks_for(tokens)} blocks needed per row, '
+                f'{self.pool.free_blocks} free') from None
+
+        started = time.perf_counter()
+        first, prefill_cache = self._prefill_rows(prompt, rows)
         first = int(first)
         self.timings['prefill'] += time.perf_counter() - started
 
         started = time.perf_counter()
-        row = self._free_rows.pop()
-        slots = self.pool.admit(row, prompt.size + max_new)
-        self._cache = adopt_prefill(self._cache, prefill_cache,
-                                    jnp.asarray(slots), row, prompt.size)
+        for row in rows:
+            self._cache = adopt_prefill(
+                self._cache, prefill_cache,
+                jnp.asarray(self.pool.adoption_slots(row)), row,
+                prompt.size)
         self._cache = write_tables(self._cache, self.pool.table)
+        if self._spec:
+            dbucket = prefill_bucket(prompt.size, self.block_size,
+                                     self._drafter.max_seq)
+            padded = np.zeros((1, dbucket), np.int32)
+            padded[0, :prompt.size] = prompt
+            _, draft_cache = self._run_prefill(self._draft_prefiller,
+                                               dbucket, padded,
+                                               prompt.size)
+            self._dcache = _adopt_draft_rows(self._dcache, draft_cache,
+                                             jnp.asarray(rows, jnp.int32),
+                                             prompt.size)
         self.timings['admit'] += time.perf_counter() - started
 
-        self._tokens[row] = first
-        self._active[row] = True
-        self._tokens_dev = self._tokens_dev.at[row].set(first)
-        self._active_dev = self._active_dev.at[row].set(True)
-        self._rowstate[row] = _RowState(tokens=[first], max_new=max_new,
+        self.sharing['admissions'] += 1
+        self.sharing['prompt_tokens'] += int(prompt.size) * fanout
+        shared_total = sum(self.pool.shared_tokens(row) for row in rows)
+        self.sharing['shared_tokens'] += shared_total
+        self.sharing['prefix_hits'] += bool(shared_total)
+
+        for row in rows:
+            self._tokens[row] = first
+            self._active[row] = True
+            self._tokens_dev = self._tokens_dev.at[row].set(first)
+            self._active_dev = self._active_dev.at[row].set(True)
+        self._rowstate[rep] = _RowState(tokens=[first], max_new=max_new,
                                         stop=stop_token, tag=tag)
-        reason = self._finish_reason(row)
+        reason = self._finish_reason(rep)
         if reason is not None:
-            self.evict(row)
-            return Admission(row, first, True, reason)
-        return Admission(row, first, False)
+            self.evict(rep)
+            return Admission(rep, first, True, reason)
+        return Admission(rep, first, False)
+
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens served from the radix
+        index (0.0 before any admission)."""
+        total = self.sharing['prompt_tokens']
+        return self.sharing['shared_tokens'] / total if total else 0.0
 
     def _finish_reason(self, row: int) -> str | None:
         state = self._rowstate[row]
@@ -299,10 +747,14 @@ class Engine:
     # ------------------------------------------------------------- decoding
 
     def step(self) -> StepReport:
-        """Advance every active row by one greedy token (one fixed-shape
-        dispatch), retire rows that hit their length or stop token."""
+        """Advance every active row (one fixed-shape dispatch): one
+        greedy token per request on the plain step, up to ``speculate +
+        1`` on the speculative step. Retires rows that hit their length
+        or stop token."""
         if not self._active.any():
             return StepReport({}, [])
+        if self._spec:
+            return self._spec_tick()
         started = time.perf_counter()
         token_dev, self._cache = self._step(self._params, self._cache,
                                             self._tokens_dev,
@@ -316,7 +768,8 @@ class Engine:
         emitted, finished = {}, []
         for row in np.flatnonzero(self._active):
             row = int(row)
-            self._tokens[row] = emitted[row] = int(token[row])
+            self._tokens[row] = int(token[row])
+            emitted[row] = [int(token[row])]
             self._rowstate[row].tokens.append(int(token[row]))
             reason = self._finish_reason(row)
             if reason is not None:
@@ -324,19 +777,56 @@ class Engine:
                 finished.append((row, reason, list(state.tokens)))
         return StepReport(emitted, finished)
 
+    def _spec_tick(self) -> StepReport:
+        started = time.perf_counter()
+        emitted_dev, accepted_dev, self._tokens_dev, self._cache, \
+            self._dcache = self._spec_step(
+                self._params, self._dparams, self._cache, self._dcache,
+                self._tokens_dev, self._active_dev)
+        window = np.asarray(emitted_dev)             # [groups, K+1]
+        accepted = np.asarray(accepted_dev)
+        self.last_step_seconds = time.perf_counter() - started
+        self.timings['step'] += self.last_step_seconds
+        fanout = self.tree_fanout
+        emitted, finished = {}, []
+        for rep in sorted(self._rowstate):
+            if not self._active[rep]:
+                continue
+            state = self._rowstate[rep]
+            group = rep // fanout
+            count = int(accepted[group]) + 1
+            toks = [int(t) for t in window[group, :count]]
+            # host truncation happens only at a finish (budget or stop),
+            # so the device cursors' extra advance dies with the evict
+            toks = toks[:state.max_new - len(state.tokens)]
+            if state.stop is not None and state.stop in toks:
+                toks = toks[:toks.index(state.stop) + 1]
+            state.tokens.extend(toks)
+            for row in range(rep, rep + fanout):
+                self._tokens[row] = toks[-1]
+            emitted[rep] = toks
+            reason = self._finish_reason(rep)
+            if reason is not None:
+                state = self.evict(rep)
+                finished.append((rep, reason, list(state.tokens)))
+        return StepReport(emitted, finished)
+
     # ------------------------------------------------------------- eviction
 
     def evict(self, row: int) -> _RowState:
-        """Retire ``row`` (finished or cancelled): its blocks return to
-        the free list, its table resets to trash — a host-side edit plus
-        one fixed-shape table write, never a retrace."""
+        """Retire ``row`` (finished or cancelled; the representative row
+        when speculative — its whole branch group retires): its blocks
+        return to the free list, its table resets to trash — a host-side
+        edit plus one fixed-shape table write, never a retrace."""
         if row not in self._rowstate:
             raise ValueError(f'row {row} is not seated')
-        self.pool.evict(row)
+        fanout = self.tree_fanout if self._spec else 1
+        for member in range(row, row + fanout):
+            self.pool.evict(member)
+            self._active[member] = False
+            self._tokens[member] = 0
+            self._active_dev = self._active_dev.at[member].set(False)
         self._cache = write_tables(self._cache, self.pool.table)
-        self._active[row] = False
-        self._tokens[row] = 0
-        self._active_dev = self._active_dev.at[row].set(False)
         self._free_rows.append(row)
         return self._rowstate.pop(row)
 

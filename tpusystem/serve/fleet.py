@@ -239,6 +239,17 @@ class ReplicaHandle:
     def backpressure(self) -> bool:
         return bool(getattr(self.scheduler, 'backpressure', False))
 
+    def cached_prefix(self, prompt) -> int:
+        """Prefix-affinity probe: how many leading prompt tokens this
+        replica's engine already holds in its radix tree (0 when the
+        engine doesn't share prefixes, or for fleet-policy fakes
+        without an engine). Never raises — affinity is a steering hint,
+        not a correctness surface."""
+        try:
+            return int(self.scheduler.engine.prefix_cached_len(prompt))
+        except (AttributeError, TypeError, *_DEAD):
+            return 0
+
     @property
     def idle(self) -> bool:
         return bool(self.replica.idle)
@@ -320,9 +331,10 @@ class FleetTick:
     shed: list                       # fleet-watermark victims this tick
     orphans: int                     # recovered rows awaiting a replica
     emitted: dict = dataclasses.field(default_factory=dict)
-    # request id -> token, merged across the replicas' ticks — what the
-    # fleet delivered this step (the recovery bench watches it for the
-    # first post-handoff token)
+    # request id -> list of tokens, merged across the replicas' ticks —
+    # what the fleet delivered this step (the recovery bench watches it
+    # for the first post-handoff token; speculative replicas can land
+    # several tokens per request per tick)
 
 
 class Router:
@@ -410,12 +422,23 @@ class Router:
                 return handle
         return None
 
-    def _targets(self, *, exclude: str | None = None) -> list[ReplicaHandle]:
+    def _targets(self, *, exclude: str | None = None,
+                 prompt=None) -> list[ReplicaHandle]:
         """Healthy replicas in placement order: calm before
-        backpressured, least-loaded first, fleet order as the stable
-        tie-break."""
+        backpressured, then — when the request's prompt is known —
+        prefix affinity (most cached leading tokens first: the replica
+        whose radix tree already holds the blocks adopts them instead
+        of re-prefilling), then least-loaded, fleet order as the stable
+        tie-break. Affinity never outranks backpressure: a calm replica
+        with a cold cache beats a backpressured one with a warm cache,
+        so a hot shared prefix cannot pile the whole fleet's traffic
+        onto one replica."""
         ranked = [handle for handle in self.healthy
                   if handle.name != exclude]
+        if prompt is not None:
+            return sorted(ranked, key=lambda handle: (
+                handle.backpressure, -handle.cached_prefix(prompt),
+                handle.depth))
         return sorted(ranked,
                       key=lambda handle: (handle.backpressure, handle.depth))
 
@@ -432,7 +455,7 @@ class Router:
                 f'high watermark and the request has no deadline — '
                 f'brownout sheds unbounded-patience work at the front door')
         now = self._clock()
-        targets = self._targets()
+        targets = self._targets(prompt=getattr(request, 'prompt', None))
         if not targets:
             raise NoHealthyReplica('no healthy replica in the fleet')
         if self.tracer is not None and request.trace is None:
@@ -581,7 +604,11 @@ class Router:
         """Re-home one row on the best survivor (or the orphan buffer
         when none is healthy), narrated as ``RequestRerouted``."""
         now = self._clock()
-        targets = self._targets(exclude=origin)
+        # affinity probes the REPLAYED prompt (original + emitted prefix)
+        # — exactly the token sequence the adopting scheduler re-prefills
+        prompt = getattr(request, 'prompt', None)
+        replay = (list(prompt) + list(emitted)) if prompt is not None else None
+        targets = self._targets(exclude=origin, prompt=replay)
         placed = None
         for handle in targets:
             try:

@@ -46,10 +46,13 @@ def serve_levers() -> dict:
     weight streaming on TPU (decode there is weight-streaming bound —
     half the bytes per step vs bf16, ``benchmarks/decode_roofline.py``),
     'auto' elsewhere (CPU decode is compute-bound and f32 keeps the
-    engine token-exact against the f32 reference). The fused Pallas
-    decode chain and speculative drafts compose with ``generate()``
-    today; the paged step is its own implementation (docs/serving.md
-    records the composition matrix)."""
+    engine token-exact against the f32 reference). The engine now
+    carries the whole PR-7 lever set natively: ``decode_impl='auto'``
+    rides the fused Pallas paged step on TPU-class backends,
+    ``share_prefix=True`` turns on radix prefix sharing, and
+    ``draft_module=`` switches to speculative rows — all composable
+    with these streaming defaults (docs/serving.md records the
+    composition matrix)."""
     if jax.default_backend() in ('tpu', 'axon'):
         return {'stream_dtype': 'int8'}
     return {'stream_dtype': 'auto'}
@@ -109,7 +112,9 @@ class Completion:
 class Tick:
     """One scheduler step's outcome."""
     admitted: list                   # [(Request, Admission, ttft_s), ...]
-    emitted: dict                    # request id -> token
+    emitted: dict                    # request id -> list of tokens emitted
+    # this step (one for the plain engine step, up to speculate+1 when
+    # the engine runs speculative rows)
     completed: list                  # [Completion, ...]
     queue_depth: int
     active: int
@@ -131,7 +136,13 @@ class Scheduler:
         prefill_budget: max prompt tokens (bucket-padded) prefilled per
             step. At least one admission always proceeds when capacity
             exists, so a prompt wider than the whole budget cannot
-            starve.
+            starve. With prefix sharing the budget counts only the
+            UNCACHED suffix (``Engine.admit_cost``) — cached prefix
+            tokens are adopted, not recomputed, so they shouldn't spend
+            prefill budget. ``admit_cost`` floors at one bucket even
+            for a fully-cached prompt, so admissions always charge a
+            nonzero cost and the one-admission rule cannot degenerate
+            into an unbounded zero-cost admission spin.
         clock: wall-time source (``time.monotonic``). Injectable so
             deadline-expiry, shedding and watchdog tests run on a fake
             clock with zero real sleeps.
@@ -399,10 +410,11 @@ class Scheduler:
             request = pending.request
             prompt = list(request.prompt) + pending.prefix
             remaining = request.max_new - len(pending.prefix)
-            cost = self.engine.bucket(len(prompt))
+            cost = self.engine.admit_cost(prompt)
             if cost > budget and budget < self.prefill_budget:
                 break                    # budget spent this step
-            if not self.engine.can_admit(len(prompt), remaining):
+            if not self.engine.can_admit(len(prompt), remaining,
+                                         prompt=prompt):
                 break                    # FIFO: wait for rows/blocks
             self._queue.popleft()
             admission = self.engine.admit(
@@ -423,12 +435,13 @@ class Scheduler:
 
         report = self.engine.step()
         emitted = {}
-        for row, token in report.emitted.items():
+        for row, tokens in report.emitted.items():
             if row in self._seated:
                 request_id = self._seated[row].request.id
-                emitted[request_id] = token
+                emitted[request_id] = list(tokens)
                 if self.journal is not None:
-                    self.journal.append(request_id, token)
+                    for token in tokens:
+                        self.journal.append(request_id, token)
         for row, reason, tokens in report.finished:
             # rows admitted directly on the engine (not through this
             # scheduler) retire without a seat here — their caller got

@@ -126,11 +126,12 @@ class InferenceService:
                     produced=len(completion.tokens),
                     reason=completion.reason,
                     seconds=completion.seconds))
-        self._emitted += len(tick.admitted) + len(tick.emitted)
+        step_tokens = sum(len(tokens) for tokens in tick.emitted.values())
+        self._emitted += len(tick.admitted) + step_tokens
         elapsed = self._clock() - self._started
         self.producer.dispatch(ServeStepped(
             step=self.scheduler.steps, active=tick.active,
-            queue_depth=tick.queue_depth, emitted=len(tick.emitted),
+            queue_depth=tick.queue_depth, emitted=step_tokens,
             tokens_per_sec=self._emitted / elapsed if elapsed else 0.0))
 
     def run_until_idle(self, max_steps: int = 10_000) -> dict:

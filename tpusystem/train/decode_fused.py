@@ -46,7 +46,12 @@ from tpusystem.ops.precision import dequantize_streamed, head_logits
 def fused_unsupported_reason(decoder) -> str | None:
     """Why ``decode_impl='fused'`` cannot run this decode clone, or
     ``None`` when it can. The fused step re-implements the GPT-2 dense
-    token-step; anything whose step math differs falls back."""
+    token-step; anything whose step math differs falls back. Scope as of
+    the serving-engine integration: unrolled dense GPT-2 runs fused here
+    AND inside :class:`tpusystem.serve.Engine` (whose paged per-row step
+    is :func:`build_fused_paged_step` — ``fused_paged_reason`` is its
+    gate); MoE now serves through the engine's flax paged step (full-
+    capacity decode dispatch), just not through this FFN chain."""
     from tpusystem.models.gpt2 import GPT2
     if not isinstance(decoder, GPT2):
         return ("the fused decode step implements the GPT2 family only "
@@ -55,10 +60,36 @@ def fused_unsupported_reason(decoder) -> str | None:
         return ('scan_layers stacks params under a leading layer dim the '
                 'fused per-layer sweep does not walk')
     if decoder.moe_experts:
-        return 'MoE blocks route through expert dispatch, not the FFN chain'
+        return ('MoE blocks route through expert dispatch, not the FFN '
+                "chain — the serving engine's flax paged step serves MoE; "
+                "this fused chain does not")
     if decoder.per_row_decode:
-        return ('per-row cache cursors (the speculative path) need the '
-                'scatter cache write')
+        return ('per-row cache cursors need the scatter cache write — '
+                "generate()'s fused loop is shared-cursor only; the "
+                "serving engine's fused PAGED step (build_fused_paged_step) "
+                'is the per-row implementation')
+    return None
+
+
+def fused_paged_reason(decoder) -> str | None:
+    """Why the serving engine's fused PAGED token-step
+    (:func:`build_fused_paged_step`) cannot run this decode clone, or
+    ``None`` when it can. Unlike :func:`fused_unsupported_reason`, the
+    paged step OWNS per-row cursors and the block-table scatter write —
+    the gates left are the step-math ones (GPT-2 dense, unrolled)."""
+    from tpusystem.models.gpt2 import GPT2
+    if not isinstance(decoder, GPT2):
+        return ('the fused paged step implements the GPT2 family only '
+                f'(got {type(decoder).__name__})')
+    if decoder.scan_layers:
+        return ('scan_layers stacks params under a leading layer dim the '
+                'fused per-layer sweep does not walk')
+    if decoder.moe_experts:
+        return ('MoE blocks route through expert dispatch, not the FFN '
+                "chain — the engine's flax paged step serves MoE")
+    if not decoder.decode_pages:
+        return ('no decode_pages on this clone — the paged step needs the '
+                "serving engine's block-pool cache layout")
     return None
 
 
@@ -102,6 +133,135 @@ def _bucketed_attention(query, key_cache, value_cache, cursor, max_seq: int):
     bucket_index = sum((filled > width).astype(jnp.int32)
                        for width in buckets[:-1])
     return jax.lax.switch(bucket_index, [attend_over(w) for w in buckets])
+
+
+def _paged_attention_fused(query, key_pool, value_pool, table, cursor,
+                           max_seq: int, block: int):
+    """One-token bucketed attention over the serving engine's PAGED pool
+    — :func:`tpusystem.ops.attention.paged_attention`'s read path (same
+    block-window buckets, same gather, same mask, same f32 softmax) for
+    ``[B, H, hd]`` queries against ``[S, H, hd]`` pools through
+    ``[B, max_blocks]`` block tables at per-row depth ``cursor``. The
+    current token's KV must already be written at its slot (the write
+    happens before the read, exactly as in ``paged_attention``)."""
+    compute = query.dtype
+    batch = query.shape[0]
+    head_dim = query.shape[-1]
+    scale = head_dim ** -0.5
+    max_blocks = max_seq // block
+
+    def attend_over(width: int):
+        def run():
+            mapped = jax.lax.slice_in_dim(table, 0, width, axis=1)
+            tokens = (mapped[:, :, None] * block
+                      + jnp.arange(block)[None, None, :]
+                      ).reshape(batch, width * block)
+            keys = jnp.take(key_pool, tokens, axis=0)    # [B, W*blk, H, hd]
+            values = jnp.take(value_pool, tokens, axis=0)
+            scores = jnp.einsum('bhd,bkhd->bhk', query, keys,
+                                preferred_element_type=jnp.float32) * scale
+            mask = (jnp.arange(width * block)[None, None, :]
+                    <= cursor[:, None, None])
+            scores = jnp.where(mask, scores, NEG_INF)
+            weights = jax.nn.softmax(scores, axis=-1)
+            return jnp.einsum('bhk,bkhd->bhd', weights.astype(compute),
+                              values)
+        return run
+
+    buckets = [min(max_blocks, max(1, 64 // block))]
+    while buckets[-1] < max_blocks:
+        buckets.append(min(2 * buckets[-1], max_blocks))
+    if len(buckets) == 1:
+        return attend_over(max_blocks)()
+    filled_blocks = (jnp.max(cursor) + block) // block
+    bucket_index = sum((filled_blocks > width).astype(jnp.int32)
+                       for width in buckets[:-1])
+    return jax.lax.switch(bucket_index, [attend_over(w) for w in buckets])
+
+
+def build_fused_paged_step(decoder):
+    """The serving engine's fused ``[rows, 1]`` token-step over the
+    paged KV pool: the :func:`build_fused` step math (Pallas
+    ``decode_matmul``/``decode_ffn``, in-kernel int8/fp8 dequant, f32
+    layernorms, tied f32-logit head) with per-row cursors, the
+    block-table scatter write, and ``paged_attention``'s bucketed
+    block-window read. Returns ``step(params, cache, tokens) ->
+    (logits, new_cache)`` where ``cache`` is the engine's paged cache
+    tree (per-layer ``key``/``value`` pools + ``table``/``index``,
+    model-level ``position``); cursor leaves in the returned cache are
+    the input's — the engine's post-step ``rewind`` owns advancement.
+    Token-exact vs the flax paged step in window-length-invariant
+    arithmetic (the contiguous fused loop's contract)."""
+    reason = fused_paged_reason(decoder)
+    if reason is not None:
+        raise ValueError(f'fused paged step unsupported: {reason}')
+    layers, heads = decoder.layers, decoder.heads
+    dim, max_seq = decoder.dim, decoder.max_seq
+    head_dim = dim // heads
+    compute = jnp.dtype(decoder.dtype)
+    num_blocks, block = decoder.decode_pages
+    max_blocks = max_seq // block
+
+    def step(params, cache, tokens):
+        rows = tokens.shape[0]
+        cursor = cache['h_0']['attn']['index']               # [rows]
+        wte = params['wte']['embedding']
+        wpe = params['wpe']['embedding']
+        embedded = (jnp.asarray(wte)[tokens].astype(jnp.float32)
+                    + jnp.asarray(wpe)[cache['position']].astype(
+                        jnp.float32))
+        hidden = embedded.astype(compute)
+        # physical token slot of this step's position through each row's
+        # table — past-capacity clamps onto the last (trash) column,
+        # exactly paged_attention's write discipline
+        logical = jnp.minimum(cursor // block, max_blocks - 1)
+        pools = {}                       # ('h_i', 'key'|'value') -> pool
+        for index in range(layers):
+            layer = params[f'h_{index}']
+            normed = _layernorm(hidden, layer['ln_1']['scale'],
+                                layer['ln_1']['bias']).astype(compute)
+            attn = layer['attn']
+            qkv = decode_matmul(normed, attn['qkv']['kernel'],
+                                attn['qkv']['bias'])
+            query, key, value = jnp.split(qkv, 3, axis=-1)
+            shape = (rows, heads, head_dim)
+            query = query.reshape(shape)
+            entry = cache[f'h_{index}']['attn']
+            table = entry['table']
+            physical = jnp.take_along_axis(table, logical[:, None],
+                                           axis=1)[:, 0]
+            slots = physical * block + cursor % block        # [rows]
+            key_pool = entry['key'].at[slots].set(
+                key.reshape(shape).astype(entry['key'].dtype))
+            value_pool = entry['value'].at[slots].set(
+                value.reshape(shape).astype(entry['value'].dtype))
+            pools[(f'h_{index}', 'key')] = key_pool
+            pools[(f'h_{index}', 'value')] = value_pool
+            context = _paged_attention_fused(query, key_pool, value_pool,
+                                             table, cursor, max_seq, block)
+            attended = decode_matmul(context.reshape(rows, dim),
+                                     attn['out']['kernel'],
+                                     attn['out']['bias'])
+            hidden = hidden + attended
+            normed = _layernorm(hidden, layer['ln_2']['scale'],
+                                layer['ln_2']['bias']).astype(compute)
+            hidden = hidden + decode_ffn(
+                normed, layer['fc']['kernel'], layer['fc']['bias'],
+                layer['proj']['kernel'], layer['proj']['bias'],
+                activation=jax.nn.gelu)
+        final = _layernorm(hidden, params['ln_f']['scale'],
+                           params['ln_f']['bias'])
+        table = jnp.asarray(wte).astype(compute)
+        logits = head_logits(final.astype(compute), table, tied=True)
+
+        def fix(path, leaf):
+            if path[-1] in (jax.tree_util.DictKey('key'),
+                            jax.tree_util.DictKey('value')):
+                return pools[(path[0].key, path[-1].key)]
+            return leaf
+        return logits, jax.tree_util.tree_map_with_path(fix, cache)
+
+    return step
 
 
 @functools.cache
