@@ -243,6 +243,17 @@ def fleet_recovery_row() -> None:
     _overlap_probe_row('serve_fleet.py', 'fleet_recovery_seconds')
 
 
+def serve_disagg_ttft_row() -> None:
+    """The disaggregated-serving head-of-line row: p99 submit→first-token
+    over the SHORT requests of a mixed long:short workload, prefill-role
+    replica streaming KV strips over the blob plane to decode-role
+    replicas vs the same replica count colocated
+    (`benchmarks/serve_disagg.py headline`; the prefill/decode split of
+    `tpusystem/serve/disagg.py` — both arms drain token-exact, the
+    colocated tail eats the long prompts' prefill latency)."""
+    _overlap_probe_row('serve_disagg.py', 'serve_disagg_ttft_p99')
+
+
 def serve_ttft_row() -> None:
     """Print the serving TTFT percentile row: p50/p95/p99 submit→first-
     token over a staggered mixed-length workload on the tiny engine,
@@ -629,6 +640,7 @@ if __name__ == '__main__':
     serve_shared_prefix_row()
     serve_recovery_row()
     fleet_recovery_row()
+    serve_disagg_ttft_row()
     embedding_row()
     serve_ttft_row()
     trace_overhead_row()

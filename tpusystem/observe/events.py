@@ -236,6 +236,22 @@ class RequestRerouted:
 
 
 @event
+class PrefillHandoff:
+    """A disaggregated fleet moved one finished prefill's KV strips
+    from the prefill tier to a decode replica: exported through
+    ``Engine.export_prefill``, shipped over the blob plane under
+    ``kv:{request}`` (digest-verified end to end), and seated through
+    ``admit_prefilled``/``adopt_prefill``. ``tokens`` is the strip's
+    coverage (prompt + any replayed prefix), ``bytes`` the payload's
+    KV weight (:mod:`tpusystem.serve.disagg`)."""
+    id: str
+    origin: str                      # prefill replica
+    target: str                      # decode replica
+    tokens: int
+    bytes: int
+
+
+@event
 class FleetResized:
     """The traffic-driven autoscaler changed the replica set: sustained
     backpressure ``'grow'``\\ s it through the provision seam (capacity

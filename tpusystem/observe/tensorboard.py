@@ -29,7 +29,8 @@ import time
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
                                       Backpressure, ElasticTimeline,
                                       EngineRestarted, FleetResized,
-                                      LoadShed, RecoveryTimeline,
+                                      LoadShed, PrefillHandoff,
+                                      RecoveryTimeline,
                                       RecsysEvaluated, ReplicaDiverged,
                                       ReplicaUnhealthy, RequestAdmitted,
                                       RequestExpired, RequestRerouted,
@@ -338,6 +339,19 @@ def tensorboard_consumer() -> Consumer:
         resize_counts[0] += 1
         board.add_scalar('fleet/replicas', float(event.replicas),
                          resize_counts[0])
+
+    handoff_counts = [0]
+
+    @consumer.handler
+    def on_prefill_handoff(event: PrefillHandoff,
+                           board: SummaryWriter = Depends(writer)) -> None:
+        handoff_counts[0] += 1
+        board.add_scalar('fleet/handoffs_total', float(handoff_counts[0]),
+                         handoff_counts[0])
+        # the KV weight each disaggregated move ships over the blob
+        # plane — the interconnect cost of splitting prefill from decode
+        board.add_scalar('fleet/handoff_bytes', float(event.bytes),
+                         handoff_counts[0])
 
     @consumer.handler
     def on_recovery(event: RecoveryTimeline,

@@ -19,9 +19,9 @@ from tpusystem.parallel.overlap import (
     reducescatter_plan, tp_ffn, tp_swiglu,
 )
 from tpusystem.parallel.schedule import (
-    FsdpPlan, MoePlan, OverlapSchedule, PpPlan, fsdp_plan, moe_plan,
-    pp_plan, resolve_schedule, schedule_applicable, scheduled_ffn,
-    scheduled_swiglu,
+    DecodeTpPlan, FsdpPlan, MoePlan, OverlapSchedule, PpPlan, decode_tp_plan,
+    fsdp_plan, moe_plan, pp_plan, resolve_schedule, schedule_applicable,
+    scheduled_ffn, scheduled_swiglu,
 )
 from tpusystem.parallel.pipeline import (PipelineParallel,
                                          compose_stacked_rules,
@@ -76,4 +76,5 @@ __all__ = ['MeshSpec', 'single_device_mesh', 'batch_sharding', 'replicated',
            'ring_allgather', 'ring_reducescatter', 'pp_hop',
            'OverlapSchedule', 'FsdpPlan', 'fsdp_plan', 'resolve_schedule',
            'PpPlan', 'pp_plan', 'MoePlan', 'moe_plan',
+           'DecodeTpPlan', 'decode_tp_plan',
            'schedule_applicable', 'scheduled_ffn', 'scheduled_swiglu']

@@ -369,6 +369,54 @@ def moe_plan(local_rows: int, expert_size: int, exchange: str = 'quota',
     return MoePlan('overlap', pieces, '')
 
 
+class DecodeTpPlan(NamedTuple):
+    """Which sharding path the serving engine's compiled steps take.
+
+    ``path`` is ``'single'`` (no mesh, or a trivial ``model`` axis — the
+    engine runs exactly as before on one device), ``'gspmd'`` (the decode
+    and prefill programs run with TP-sharded matmuls: params placed by
+    the module's ``partition_rules()``, the paged KV pool sharded over
+    heads, block tables replicated so the host keeps ONE authority), or
+    ``'unsupported'`` (the mesh carries a non-trivial axis serving cannot
+    shard over — data/fsdp/seq/expert/stage parallelism belongs to
+    training; serving batches are row-churned, not data-sharded).
+    ``model`` is the TP degree, ``reason`` documents a fallback or gate.
+    """
+
+    path: str
+    model: int
+    reason: str
+
+
+def decode_tp_plan(mesh) -> DecodeTpPlan:
+    """Plan the engine's TP sharding — pure, so tests can pin the path.
+
+    ``mesh`` is a built :class:`jax.sharding.Mesh` (or ``None``). Only
+    the ``model`` axis may exceed 1: the engine's row dimension churns
+    every step (admit/evict rewrite individual rows in place), so
+    sharding rows across devices would turn every seat into a
+    cross-device scatter. The fused Pallas chain has no ring arms yet —
+    :func:`~tpusystem.train.decode_fused.fused_paged_reason` gates it
+    separately and ``decode_impl='auto'`` falls back to the sharded
+    flax step.
+    """
+    if mesh is None:
+        return DecodeTpPlan('single', 1, 'no mesh')
+    sizes = dict(getattr(mesh, 'shape', {}))
+    model = sizes.get(MODEL, 1)
+    offending = {axis: size for axis, size in sizes.items()
+                 if axis != MODEL and size > 1}
+    if offending:
+        return DecodeTpPlan(
+            'unsupported', model,
+            f'serving shards over the {MODEL!r} axis only; mesh carries '
+            f'non-trivial {sorted(offending)} — rows churn in place every '
+            'step, so data-style sharding would scatter every seat')
+    if model == 1:
+        return DecodeTpPlan('single', 1, 'model axis of size 1')
+    return DecodeTpPlan('gspmd', model, '')
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
 def _ring_gather(axis, dim, chunks, shard):
     return ring_allgather(shard, axis, dimension=dim, chunks=chunks)

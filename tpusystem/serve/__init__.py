@@ -33,9 +33,23 @@ Layers, bottom up:
   retry / hedging, journal handoff onto the survivors when a replica
   dies, fleet-scope watermark shedding with brownout, and
   traffic-driven autoscale through the supervisor/elastic resize seam
-  (docs/serving.md "A fleet of replicas").
+  (docs/serving.md "A fleet of replicas");
+* disaggregated prefill/decode (:mod:`tpusystem.serve.disagg`) — a
+  ``role='prefill'`` replica runs only admission prefill and exports
+  each request's KV strips (``Engine.export_prefill``); the router
+  ships them over the chunked digest-verified blob plane under
+  ``kv:{request}`` (:class:`KVHandoff` / :class:`KVStripStore`) to a
+  decode replica that seats them through ``Engine.admit_prefilled`` —
+  the existing ``adopt_prefill``/``write_tables`` seam. Engines also
+  take ``mesh=``/``schedule=`` to tensor-shard the compiled steps over
+  the ``'model'`` axis (GSPMD; token-exact vs single-device)
+  (docs/serving.md "Disaggregated prefill/decode").
 """
 
+from tpusystem.serve.disagg import (HandoffCorrupt, KVHandoff, KVStripStore,
+                                    RoleMismatch, fetch_handoff,
+                                    kv_namespace, pack_handoff,
+                                    unpack_handoff)
 from tpusystem.serve.engine import (Admission, Engine, Saturated,
                                     StepReport, engine_unsupported_reason,
                                     prefill_bucket)
@@ -49,7 +63,8 @@ from tpusystem.serve.fleet import (AutoscalePolicy, FleetSaturated,
                                    ReplicaDead, ReplicaHandle, RoutePolicy,
                                    Router)
 from tpusystem.serve.kvcache import (TRASH_BLOCK, PagedKVCache,
-                                     adopt_prefill, write_tables)
+                                     adopt_prefill, pool_shardings,
+                                     write_tables)
 from tpusystem.serve.scheduler import (Completion, QueueFull, Request,
                                        Scheduler, Tick, serve_levers)
 from tpusystem.serve.service import InferenceService
@@ -63,4 +78,7 @@ __all__ = ['Engine', 'Admission', 'StepReport', 'Saturated',
            'ReplayReport', 'ServingReplica', 'StepWatchdog', 'Watermarks',
            'journal_identity', 'recover_journal', 'replay',
            'Router', 'ReplicaHandle', 'RoutePolicy', 'AutoscalePolicy',
-           'FleetTick', 'ReplicaDead', 'NoHealthyReplica', 'FleetSaturated']
+           'FleetTick', 'ReplicaDead', 'NoHealthyReplica', 'FleetSaturated',
+           'KVHandoff', 'KVStripStore', 'HandoffCorrupt', 'RoleMismatch',
+           'kv_namespace', 'pack_handoff', 'unpack_handoff', 'fetch_handoff',
+           'pool_shardings']

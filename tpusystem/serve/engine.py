@@ -66,7 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpusystem.serve.kvcache import (PagedKVCache, _is_kv, adopt_prefill,
-                                     write_tables)
+                                     pool_shardings, write_tables)
 from tpusystem.train.cursors import gather_rows, is_cursor, read_cursor, rewind
 from tpusystem.train.decode_fused import (build_fused_paged_step,
                                           fused_paged_reason)
@@ -303,6 +303,23 @@ class Engine:
         speculate: draft tokens proposed per speculative step.
         tree_fanout: branch rows per request (token-tree verify);
             ``rows`` must be a multiple.
+        mesh: a :class:`~tpusystem.parallel.mesh.MeshSpec` or built
+            :class:`jax.sharding.Mesh` to TP-shard the compiled steps
+            over — params placed by the module's ``partition_rules()``,
+            the paged KV pool sharded over heads
+            (:func:`~tpusystem.serve.kvcache.pool_shardings`), block
+            tables replicated so the host pool stays the one authority.
+            Only the ``model`` axis may exceed 1
+            (:func:`~tpusystem.parallel.schedule.decode_tp_plan` is the
+            gate); ``decode_impl='fused'`` raises under TP (no ring arms
+            yet — ``'auto'`` serves the sharded flax step, token-exact
+            vs single-device).
+        schedule: an :class:`~tpusystem.parallel.schedule.OverlapSchedule`
+            threaded onto the decode/prefill clones — per-shape
+            ``schedule_applicable`` gating decides whether any program
+            takes the manual shard_map path (decode's ``[rows, 1]``
+            shapes typically fall back to GSPMD; prefill buckets may
+            qualify).
 
     The decode step traces exactly once per engine (``trace_count`` is
     the witness); admissions and evictions are host-side table edits
@@ -314,7 +331,7 @@ class Engine:
                  stream_dtype: str = 'auto', decode_impl: str = 'auto',
                  share_prefix: bool = False, draft_module=None,
                  draft_params=None, speculate: int = 4,
-                 tree_fanout: int = 1) -> None:
+                 tree_fanout: int = 1, mesh=None, schedule=None) -> None:
         reason = engine_unsupported_reason(module)
         if reason is not None:
             raise ValueError(f'the serving engine cannot run this module: '
@@ -327,6 +344,12 @@ class Engine:
         self.share_prefix = share_prefix
         self.speculate, self.tree_fanout = speculate, tree_fanout
         self._spec = draft_module is not None
+        self.mesh, self.tp_plan = self._resolve_mesh(mesh)
+        if self._spec and self.tp_plan.path == 'gspmd':
+            raise ValueError(
+                'mesh= does not compose with speculative rows yet — the '
+                'draft cache has no sharding contract; serve the plain '
+                'engine under TP')
         if self._spec:
             if speculate < 1:
                 raise ValueError(f'speculate must be >= 1, got {speculate}')
@@ -344,7 +367,20 @@ class Engine:
         self._decoder = dataclasses.replace(
             _decoder(module, per_row=True),
             decode_pages=(blocks, block_size))
+        if self.tp_plan.path == 'gspmd':
+            # re-attach what _decoder deliberately dropped: the live mesh
+            # (unhashable — the compile caches' TypeError fallback absorbs
+            # it) and the overlap schedule, on BOTH clones so prefill and
+            # decode shard identically
+            self._prefiller = dataclasses.replace(
+                self._prefiller, mesh=self.mesh, schedule=schedule)
+            self._decoder = dataclasses.replace(
+                self._decoder, mesh=self.mesh, schedule=schedule)
         self._params = _stream_params(self._decoder, params, stream_dtype)
+        if self.tp_plan.path == 'gspmd':
+            from tpusystem.parallel.sharding import TensorParallel
+            self._params = TensorParallel(module.partition_rules()).place(
+                self._params, self.mesh)
         self.decode_impl = self._resolve_decode_impl(decode_impl)
         self.pool = PagedKVCache(rows, blocks, block_size, self.max_seq,
                                  share_prefix=share_prefix)
@@ -353,6 +389,9 @@ class Engine:
             jnp.zeros((rows, 1), jnp.int32))['cache']
         self._cache = jax.tree.map(
             lambda leaf: jnp.zeros(leaf.shape, leaf.dtype), shapes)
+        if self.tp_plan.path == 'gspmd':
+            self._cache = jax.device_put(
+                self._cache, pool_shardings(self._cache, self.mesh))
         # free seats: representative rows — every row when linear, the
         # first row of each fanout-wide adjacent group when speculative
         stride = self.tree_fanout if self._spec else 1
@@ -364,6 +403,11 @@ class Engine:
         self._active = np.zeros(rows, bool)
         self._tokens_dev = jnp.zeros(rows, jnp.int32)
         self._active_dev = jnp.zeros(rows, bool)
+        if self.tp_plan.path == 'gspmd':
+            from jax.sharding import NamedSharding, PartitionSpec
+            everywhere = NamedSharding(self.mesh, PartitionSpec())
+            self._tokens_dev = jax.device_put(self._tokens_dev, everywhere)
+            self._active_dev = jax.device_put(self._active_dev, everywhere)
         self._rowstate: dict[int, _RowState] = {}
         self._prefills: dict[object, object] = {}  # unhashable-module path
         self._resumes: dict[int, object] = {}
@@ -424,6 +468,22 @@ class Engine:
                                      jnp.where(active, cursor + 1, 0))
 
         self._step = jax.jit(step_fn, donate_argnums=(1,))
+
+    @staticmethod
+    def _resolve_mesh(mesh):
+        """Build a MeshSpec, pass a live Mesh through, and gate via
+        :func:`~tpusystem.parallel.schedule.decode_tp_plan` — the typed
+        'unsupported' plan (any non-``model`` axis > 1) raises here, so
+        an engine that constructs is an engine whose sharding works."""
+        from tpusystem.parallel.schedule import decode_tp_plan
+        if mesh is not None and hasattr(mesh, 'build'):
+            mesh = mesh.build()
+        plan = decode_tp_plan(mesh)
+        if plan.path == 'unsupported':
+            raise ValueError(
+                f'the serving engine cannot shard over this mesh: '
+                f'{plan.reason}')
+        return (mesh if plan.path == 'gspmd' else None), plan
 
     def _resolve_decode_impl(self, decode_impl: str) -> str:
         if decode_impl not in ('auto', 'flax', 'fused'):
@@ -636,14 +696,7 @@ class Engine:
         return self._run_prefill(self._prefiller, bucket, padded,
                                  prompt.size)
 
-    def admit(self, prompt, max_new: int, *, stop_token: int | None = None,
-              tag=None) -> Admission:
-        """Prefill ``prompt`` and seat it in a free row (a free GROUP of
-        ``tree_fanout`` adjacent rows when speculative). Raises
-        :class:`Saturated` when no row or not enough blocks are free
-        (the scheduler queues on this), ``ValueError`` on requests that
-        could never fit."""
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
+    def _validate(self, prompt, max_new: int) -> None:
         if prompt.size < 1:
             raise ValueError('empty prompt')
         if max_new < 1:
@@ -660,6 +713,11 @@ class Engine:
                     f'the draft cache capacity max_seq='
                     f'{self._drafter.max_seq} (the draft overshoots by up '
                     'to speculate tokens before rewinding)')
+
+    def _seat(self, prompt, max_new: int) -> tuple[int, list[int]]:
+        """Claim a free row group and seat it in the pool (rolled back
+        whole on a mid-flight block shortfall) — the Saturated half of
+        admission, shared by :meth:`admit` and :meth:`admit_prefilled`."""
         if not self._free_rows:
             raise Saturated('no free row')
         if not self.can_admit(prompt.size, max_new, prompt=prompt):
@@ -685,6 +743,42 @@ class Engine:
             raise Saturated(
                 f'{self.pool.blocks_for(tokens)} blocks needed per row, '
                 f'{self.pool.free_blocks} free') from None
+        return rep, rows
+
+    def _register(self, rep: int, rows: list[int], prompt, first: int,
+                  max_new: int, stop_token: int | None, tag) -> Admission:
+        """The host-side admission tail: sharing counters, row state,
+        token/active mirrors, and the admitted-already-finished check."""
+        fanout = self.tree_fanout if self._spec else 1
+        self.sharing['admissions'] += 1
+        self.sharing['prompt_tokens'] += int(prompt.size) * fanout
+        shared_total = sum(self.pool.shared_tokens(row) for row in rows)
+        self.sharing['shared_tokens'] += shared_total
+        self.sharing['prefix_hits'] += bool(shared_total)
+
+        for row in rows:
+            self._tokens[row] = first
+            self._active[row] = True
+            self._tokens_dev = self._tokens_dev.at[row].set(first)
+            self._active_dev = self._active_dev.at[row].set(True)
+        self._rowstate[rep] = _RowState(tokens=[first], max_new=max_new,
+                                        stop=stop_token, tag=tag)
+        reason = self._finish_reason(rep)
+        if reason is not None:
+            self.evict(rep)
+            return Admission(rep, first, True, reason)
+        return Admission(rep, first, False)
+
+    def admit(self, prompt, max_new: int, *, stop_token: int | None = None,
+              tag=None) -> Admission:
+        """Prefill ``prompt`` and seat it in a free row (a free GROUP of
+        ``tree_fanout`` adjacent rows when speculative). Raises
+        :class:`Saturated` when no row or not enough blocks are free
+        (the scheduler queues on this), ``ValueError`` on requests that
+        could never fit."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self._validate(prompt, max_new)
+        rep, rows = self._seat(prompt, max_new)
 
         started = time.perf_counter()
         first, prefill_cache = self._prefill_rows(prompt, rows)
@@ -710,25 +804,96 @@ class Engine:
                                              jnp.asarray(rows, jnp.int32),
                                              prompt.size)
         self.timings['admit'] += time.perf_counter() - started
+        return self._register(rep, rows, prompt, first, max_new,
+                              stop_token, tag)
 
-        self.sharing['admissions'] += 1
-        self.sharing['prompt_tokens'] += int(prompt.size) * fanout
-        shared_total = sum(self.pool.shared_tokens(row) for row in rows)
-        self.sharing['shared_tokens'] += shared_total
-        self.sharing['prefix_hits'] += bool(shared_total)
+    # ------------------------------------------------- disaggregated prefill
 
+    def export_prefill(self, prompt) -> tuple[int, dict]:
+        """Run the admission prefill WITHOUT seating a row — the
+        prefill-role half of disaggregated serving. Returns ``(first,
+        kv)``: the prompt's first token and every layer's contiguous KV
+        strip (``keystr path -> [1, max_seq, heads, head_dim]`` numpy,
+        host-side so the blob plane can ship it). The decode-role
+        replica seats it with :meth:`admit_prefilled`; this engine's
+        pool, rows and sharing index are untouched."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError('empty prompt')
+        if prompt.size >= self.max_seq:
+            raise ValueError(
+                f'prompt ({prompt.size}) leaves no decode room under '
+                f'max_seq={self.max_seq}')
+        bucket = self.bucket(prompt.size)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :prompt.size] = prompt
+        started = time.perf_counter()
+        first, prefill_cache = self._run_prefill(self._prefiller, bucket,
+                                                 padded, prompt.size)
+        first = int(first)
+        self.timings['prefill'] += time.perf_counter() - started
+        kv = {jax.tree_util.keystr(path): np.asarray(leaf)
+              for path, leaf
+              in jax.tree_util.tree_leaves_with_path(prefill_cache)
+              if _is_kv(path)}
+        return first, kv
+
+    def _strip_cache(self, kv: dict):
+        """Rebuild a contiguous prefill cache pytree from exported KV
+        strips — the receiving half of :meth:`export_prefill`. Missing
+        or misshapen strips raise ``ValueError`` (prefill and decode
+        replicas must serve the same module geometry)."""
+        shapes = jax.eval_shape(
+            functools.partial(self._prefiller.init, jax.random.PRNGKey(0)),
+            jnp.zeros((1, 1), jnp.int32))['cache']
+
+        def fill(path, leaf):
+            if not _is_kv(path):
+                return jnp.zeros(leaf.shape, leaf.dtype)
+            name = jax.tree_util.keystr(path)
+            if name not in kv:
+                raise ValueError(
+                    f'handoff strip missing KV leaf {name} — prefill and '
+                    'decode replicas must serve the same module')
+            strip = kv[name]
+            if tuple(strip.shape) != tuple(leaf.shape):
+                raise ValueError(
+                    f'handoff strip {name} is {tuple(strip.shape)}, this '
+                    f'engine expects {tuple(leaf.shape)} — prefill and '
+                    'decode replicas must serve the same module geometry')
+            return jnp.asarray(strip, leaf.dtype)
+        return jax.tree_util.tree_map_with_path(fill, shapes)
+
+    def admit_prefilled(self, prompt, max_new: int, first: int, kv: dict,
+                        *, stop_token: int | None = None,
+                        tag=None) -> Admission:
+        """Seat a request whose prefill ran on ANOTHER engine
+        (:meth:`export_prefill` strips, shipped over the blob plane).
+        Same contract as :meth:`admit` — Saturated when nothing is free,
+        ValueError on requests that could never fit — but the only
+        device work is the existing ``adopt_prefill``/``write_tables``
+        admission seam: no prefill program runs here, which is the whole
+        point of the disaggregated split."""
+        if self._spec:
+            raise ValueError(
+                'admit_prefilled does not compose with speculative rows — '
+                'the draft cache has no handoff strip; disaggregate the '
+                'plain engine')
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self._validate(prompt, max_new)
+        prefill_cache = self._strip_cache(kv)     # validate BEFORE seating
+        rep, rows = self._seat(prompt, max_new)
+
+        started = time.perf_counter()
         for row in rows:
-            self._tokens[row] = first
-            self._active[row] = True
-            self._tokens_dev = self._tokens_dev.at[row].set(first)
-            self._active_dev = self._active_dev.at[row].set(True)
-        self._rowstate[rep] = _RowState(tokens=[first], max_new=max_new,
-                                        stop=stop_token, tag=tag)
-        reason = self._finish_reason(rep)
-        if reason is not None:
-            self.evict(rep)
-            return Admission(rep, first, True, reason)
-        return Admission(rep, first, False)
+            self._cache = adopt_prefill(
+                self._cache, prefill_cache,
+                jnp.asarray(self.pool.adoption_slots(row)), row,
+                prompt.size)
+        self._cache = write_tables(self._cache, self.pool.table)
+        self.timings['admit'] += time.perf_counter() - started
+        return self._register(rep, rows, prompt, int(first), max_new,
+                              stop_token, tag)
 
     def prefix_hit_rate(self) -> float:
         """Fraction of admitted prompt tokens served from the radix

@@ -518,11 +518,21 @@ class ServingReplica:
                  deadman: bool = False, producer: Any = None,
                  clock: Callable[[], float] = time.monotonic,
                  fault: Callable[[int], None] | None = None,
-                 recorder: Any = None) -> None:
+                 recorder: Any = None, role: str = 'both') -> None:
         if deadman and (watchdog is None or watchdog.stall_after is None):
             raise ValueError('deadman=True needs a watchdog with '
                              'stall_after set (the timer interval)')
+        if role not in ('both', 'prefill', 'decode'):
+            raise ValueError(f"role must be 'both', 'prefill' or 'decode', "
+                             f'got {role!r}')
         self._build = build
+        self.role = role
+        # placement policy, not capability: a 'decode' replica keeps its
+        # full prefill programs (recovery re-prefills journaled rows on
+        # it); only 'prefill' changes the scheduler contract, and that
+        # is build()'s job (Scheduler(prefill_only=True)) — enforced in
+        # _boot so a mis-built replica fails at construction, not when
+        # the first strip goes missing
         self.identity = identity
         self.client = client
         self.fallbacks = tuple(fallbacks)
@@ -549,6 +559,12 @@ class ServingReplica:
               live: RequestJournal | None = None) -> None:
         started = self._clock()
         self.scheduler = self._build()
+        prefill_only = getattr(self.scheduler, 'prefill_only', False)
+        if prefill_only != (self.role == 'prefill'):
+            raise ValueError(
+                f'replica role {self.role!r} but build() constructed a '
+                f'scheduler with prefill_only={prefill_only} — the role '
+                'and the scheduler contract must agree')
         scheduler_clock = getattr(self.scheduler, '_clock', self._clock)
         if scheduler_clock is not self._clock:
             raise ValueError(

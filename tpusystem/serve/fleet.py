@@ -72,6 +72,8 @@ import time
 from typing import Any, Callable
 
 from tpusystem.parallel.chaos import WorkerKilled
+from tpusystem.serve.disagg import (HandoffCorrupt, kv_namespace,
+                                    pack_handoff, unpack_handoff)
 from tpusystem.serve.failover import Watermarks, recover_journal
 from tpusystem.serve.scheduler import QueueFull
 from tpusystem.serve.engine import Saturated
@@ -200,10 +202,23 @@ class ReplicaHandle:
     (every later touch raises :exc:`ReplicaDead`), while the journal's
     out-of-process store — the supervisor RAM a real kill leaves behind
     — survives in ``journal_clients``.
+
+    ``role`` is the disaggregated-serving placement tier (defaults to
+    the replica's own ``role`` attribute, else ``'both'``):
+    ``'prefill'`` replicas take new submissions and export KV handoffs
+    (their scheduler is ``prefill_only``); ``'decode'`` replicas seat
+    shipped strips and decode. Role is *placement policy, not
+    capability* — a decode replica keeps its full prefill programs, so
+    journal recovery can re-prefill rows on it. ``transport``/``rank``
+    give the handle a blob plane: when both ends of a handoff carry
+    one, the strips travel ``send_blob``/``fetch_blob`` (chunked,
+    digest-verified) instead of by direct reference.
     """
 
     def __init__(self, replica: Any, *, name: str | None = None,
-                 journal_clients: tuple = (), external: bool = False) -> None:
+                 journal_clients: tuple = (), external: bool = False,
+                 role: str | None = None, transport: Any = None,
+                 rank: int = 0) -> None:
         self.replica = replica
         self.identity = getattr(replica, 'identity', None) or name or 'serve'
         self.name = name or self.identity
@@ -213,6 +228,13 @@ class ReplicaHandle:
             self.journal_clients = (getattr(replica, 'client', None),
                                     *getattr(replica, 'fallbacks', ()))
         self.external = external
+        self.role = role or getattr(replica, 'role', 'both')
+        if self.role not in ('both', 'prefill', 'decode'):
+            raise ValueError(f"role must be 'both', 'prefill' or 'decode', "
+                             f'got {self.role!r}')
+        self.transport = transport
+        self.rank = rank
+        self.strips = None           # KVStripStore, attached on first offer
         self.healthy = True
         self.cause: str | None = None
         self.placements = 0          # submits + restores routed here
@@ -232,8 +254,10 @@ class ReplicaHandle:
 
     @property
     def depth(self) -> int:
-        """Load metric for least-loaded placement: queued + seated."""
-        return self.scheduler.queue_depth + self.scheduler.active
+        """Load metric for least-loaded placement: queued + seated (+
+        exported handoffs awaiting shipment on a prefill replica)."""
+        return (self.scheduler.queue_depth + self.scheduler.active
+                + len(getattr(self.scheduler, 'outbox', ())))
 
     @property
     def backpressure(self) -> bool:
@@ -303,6 +327,38 @@ class ReplicaHandle:
         self._check()
         return self.replica.step()
 
+    # ------------------------------------------------- disaggregated seams
+
+    def take_handoffs(self) -> list:
+        """Drain a prefill replica's exported KV handoffs (empty for
+        schedulers without the seam — fleet-policy fakes)."""
+        self._check()
+        take = getattr(self.scheduler, 'take_handoffs', None)
+        return take() if take is not None else []
+
+    def shipped(self, request_id: str) -> None:
+        """Ack a delivered handoff on the prefill side (journal row
+        closes, trace span ends)."""
+        self._check()
+        self.scheduler.shipped(request_id)
+
+    def ingest(self, handoff: Any, *, waited: float = 0.0) -> None:
+        """Queue a shipped handoff on this (decode-capable) replica."""
+        self._check()
+        self.scheduler.ingest(handoff, waited=waited)
+        self.placements += 1
+
+    def offer_strips(self, request_id: str, payload: bytes) -> None:
+        """Publish a packed handoff on this handle's blob-request plane
+        (``kv:{request}``), creating and chaining the
+        :class:`~tpusystem.serve.disagg.KVStripStore` on first use."""
+        if self.strips is None:
+            from tpusystem.serve.disagg import KVStripStore
+            self.strips = KVStripStore()
+            if self.transport is not None:
+                self.strips.attach(self.transport)
+        self.strips.offer(request_id, payload)
+
 
 @dataclasses.dataclass
 class _Route:
@@ -330,6 +386,8 @@ class FleetTick:
     rerouted: list                   # RequestRerouted narrations this tick
     shed: list                       # fleet-watermark victims this tick
     orphans: int                     # recovered rows awaiting a replica
+    handoffs: list = dataclasses.field(default_factory=list)
+    # request ids whose KV strips moved prefill -> decode this tick
     emitted: dict = dataclasses.field(default_factory=dict)
     # request id -> list of tokens, merged across the replicas' ticks —
     # what the fleet delivered this step (the recovery bench watches it
@@ -403,6 +461,7 @@ class Router:
         self.ticks = 0
         self._routes: dict[str, _Route] = {}
         self._orphans: list = []     # (request, submitted_at, prefix) rows
+        self._undelivered: list = []  # (source_name, KVHandoff) retry queue
         self._reroutes_pending: list = []   # drained into the next FleetTick
         self._pressure_ticks = 0
         self._idle_ticks = 0
@@ -422,8 +481,15 @@ class Router:
                 return handle
         return None
 
+    @property
+    def _split_roles(self) -> bool:
+        """Whether the fleet runs a dedicated prefill tier (any healthy
+        ``role='prefill'`` handle) — the switch that turns on role-aware
+        placement and the handoff pump."""
+        return any(handle.role == 'prefill' for handle in self.healthy)
+
     def _targets(self, *, exclude: str | None = None,
-                 prompt=None) -> list[ReplicaHandle]:
+                 prompt=None, role: str | None = None) -> list[ReplicaHandle]:
         """Healthy replicas in placement order: calm before
         backpressured, then — when the request's prompt is known —
         prefix affinity (most cached leading tokens first: the replica
@@ -432,9 +498,22 @@ class Router:
         tie-break. Affinity never outranks backpressure: a calm replica
         with a cold cache beats a backpressured one with a warm cache,
         so a hot shared prefix cannot pile the whole fleet's traffic
-        onto one replica."""
+        onto one replica.
+
+        ``role`` picks the tier in a split fleet: ``'prefill'`` ranks
+        the prefill replicas by queue depth (prompts go where admission
+        prefill will run soonest); ``'decode'`` ranks the
+        decode-capable replicas (``role != 'prefill'``) by decode
+        occupancy with prefix affinity preserved within the tier;
+        ``None`` keeps the whole fleet (the colocated contract)."""
         ranked = [handle for handle in self.healthy
                   if handle.name != exclude]
+        if role == 'prefill':
+            ranked = [handle for handle in ranked
+                      if handle.role == 'prefill']
+        elif role == 'decode':
+            ranked = [handle for handle in ranked
+                      if handle.role != 'prefill']
         if prompt is not None:
             return sorted(ranked, key=lambda handle: (
                 handle.backpressure, -handle.cached_prefix(prompt),
@@ -455,7 +534,11 @@ class Router:
                 f'high watermark and the request has no deadline — '
                 f'brownout sheds unbounded-patience work at the front door')
         now = self._clock()
-        targets = self._targets(prompt=getattr(request, 'prompt', None))
+        # a split fleet admits prompts on the prefill tier (ranked by
+        # queue depth — where admission prefill runs soonest); the KV
+        # strip reaches a decode replica through the handoff pump
+        targets = self._targets(prompt=getattr(request, 'prompt', None),
+                                role='prefill' if self._split_roles else None)
         if not targets:
             raise NoHealthyReplica('no healthy replica in the fleet')
         if self.tracer is not None and request.trace is None:
@@ -511,6 +594,16 @@ class Router:
                     if entry[0].id == request_id]
         for entry in orphaned:
             self._orphans.remove(entry)
+        # a handoff parked between tiers dies here too: ack the prefill
+        # side (clears its shipping ledger) and drop the strips
+        parked = [entry for entry in self._undelivered
+                  if entry[1].request.id == request_id]
+        for entry in parked:
+            self._undelivered.remove(entry)
+            source = self._by_name(entry[0])
+            if source is not None:
+                source.shipped(request_id)
+        orphaned = orphaned or parked
         if route is None:
             return 'queued' if orphaned else None
         where = 'queued' if orphaned else None
@@ -608,7 +701,22 @@ class Router:
         # — exactly the token sequence the adopting scheduler re-prefills
         prompt = getattr(request, 'prompt', None)
         replay = (list(prompt) + list(emitted)) if prompt is not None else None
-        targets = self._targets(exclude=origin, prompt=replay)
+        # a hot row (emitted prefix) re-prefills AND decodes — only a
+        # decode-capable replica may adopt it (a prefill-only scheduler
+        # raises RoleMismatch, typed precisely so it cannot be mistaken
+        # for the finished-row ValueError below). A cold row re-enters
+        # at the front door: the prefill tier when one exists.
+        role = None
+        if self._split_roles:
+            role = 'decode' if emitted else 'prefill'
+        targets = self._targets(exclude=origin, prompt=replay, role=role)
+        if not targets and role == 'prefill':
+            # no prefill replica can take it (the origin WAS the tier):
+            # decode replicas keep their full prefill programs — role is
+            # placement policy, not capability — so a cold row lands
+            # there rather than orphaning
+            targets = self._targets(exclude=origin, prompt=replay,
+                                    role='decode')
         placed = None
         for handle in targets:
             try:
@@ -703,6 +811,7 @@ class Router:
                 self._settle(completion, handle, completed)
             for completion, _slack in tick.shed:
                 self._settle(completion, handle, completed)
+        handoffs = self._pump_handoffs()
         self._retry_and_hedge()
         shed = self._fleet_shed()
         self._breathe()
@@ -712,7 +821,133 @@ class Router:
         return FleetTick(replicas=len(self.healthy), queued=queued,
                          active=active, completed=completed,
                          rerouted=reroutes, shed=shed,
-                         orphans=len(self._orphans), emitted=emitted)
+                         orphans=len(self._orphans), handoffs=handoffs,
+                         emitted=emitted)
+
+    # ------------------------------------------------------------ handoff
+
+    def _pump_handoffs(self) -> list:
+        """Move every finished prefill's KV strips to a decode replica:
+        drain each healthy prefill handle's outbox, deliver over the
+        blob plane when both sides carry a transport (offered under
+        ``kv:{request}``, fetched chunk-digest-verified, released on
+        ack) or in-process otherwise, verify the end-to-end digest, and
+        seat the strip through the target's ``ingest`` →
+        ``admit_prefilled`` → ``adopt_prefill`` chain. Returns the
+        request ids that moved this tick. A corrupt payload falls back
+        to a cold re-place (the prompt re-prefills — slower, never
+        wrong); no healthy decode target parks the handoff in the
+        ``_undelivered`` retry queue, drained first next tick."""
+        moved: list = []
+        retries, self._undelivered = self._undelivered, []
+        for source_name, handoff in retries:
+            source = self._by_name(source_name)
+            if source is None or not source.healthy:
+                # the prefill replica died after export: the strips are
+                # gone with it, but the prompt is not — re-place cold,
+                # unless the journal recovery in _fail already re-homed
+                # the row (or a hedge settled it)
+                route = self._routes.get(handoff.request.id)
+                if (handoff.request.id in self.results
+                        or (route is not None
+                            and route.handle != source_name
+                            and self._is_healthy(route.handle))):
+                    continue
+                self._place(handoff.request, handoff.waited,
+                            list(handoff.prefix),
+                            origin=source_name or 'handoffs',
+                            cause='failover', route=route)
+                continue
+            self._deliver(source, handoff, moved)
+        for handle in list(self.handles):
+            if not handle.healthy or handle.role != 'prefill':
+                continue
+            try:
+                outbox = handle.take_handoffs()
+            except _DEAD as death:
+                self._fail(handle, f'died at handoff export ({death})')
+                continue
+            for handoff in outbox:
+                self._deliver(handle, handoff, moved)
+        return moved
+
+    def _deliver(self, source: ReplicaHandle, handoff, moved: list) -> None:
+        request = handoff.request
+        if request.id in self.results:   # settled while queued (cancel/shed)
+            source.shipped(request.id)
+            return
+        now = self._clock()
+        # decode-side affinity probes prompt + replayed prefix — the
+        # tokens whose blocks a warm radix tree could already hold
+        prompt = getattr(request, 'prompt', None)
+        replay = ((list(prompt) + list(handoff.prefix))
+                  if prompt is not None else None)
+        targets = self._targets(exclude=source.name, prompt=replay,
+                                role='decode')
+        route = self._routes.get(request.id)
+        placed = None
+        for target in targets:
+            try:
+                if (source.transport is not None
+                        and target.transport is not None):
+                    # the real disaggregation wire: offer on the prefill
+                    # side, pull over the chunked digest-verified blob
+                    # plane, release on ack — a fetch that dies mid-
+                    # flight just retries, the strip is still offered
+                    source.offer_strips(request.id, pack_handoff(handoff))
+                    data = target.transport.fetch_blob(
+                        source.rank, kv_namespace(request.id))
+                    source.strips.release(request.id)
+                else:
+                    data = pack_handoff(handoff)
+                received = unpack_handoff(data)
+            except HandoffCorrupt as corrupt:
+                logger.warning(
+                    'KV handoff for %r failed verification (%s); '
+                    're-prefilling cold on the decode tier', request.id,
+                    corrupt)
+                source.shipped(request.id)
+                self._place(request, handoff.waited, list(handoff.prefix),
+                            origin=source.name, cause='handoff-corrupt',
+                            route=route)
+                return
+            except _DEAD as death:
+                self._fail(target, f'died at handoff ingest ({death})')
+                continue
+            try:
+                waited = (now - route.submitted if route is not None
+                          else handoff.waited)
+                target.ingest(received, waited=waited)
+            except _DEAD as death:
+                self._fail(target, f'died at handoff ingest ({death})')
+                continue
+            placed = target
+            break
+        if placed is None:
+            self._undelivered.append((source.name, handoff))
+            logger.warning('no healthy decode replica can seat %r; handoff '
+                           'parked for retry', request.id)
+            return
+        if route is None:
+            route = self._routes[request.id] = _Route(
+                request, placed.name, now - handoff.waited, now)
+        route.handle, route.routed_at = placed.name, now
+        source.shipped(request.id)
+        moved.append(request.id)
+        size = sum(getattr(strip, 'nbytes', 0)
+                   for strip in handoff.kv.values())
+        tokens = (len(prompt) if prompt is not None else 0) \
+            + len(handoff.prefix)
+        if self.tracer is not None:
+            self.tracer.instant(
+                'kv-handoff', cat='fleet', trace=request.trace,
+                args={'request': request.id, 'origin': source.name,
+                      'target': placed.name, 'tokens': tokens,
+                      'bytes': size})
+        from tpusystem.observe.events import PrefillHandoff
+        self._dispatch(PrefillHandoff(
+            id=request.id, origin=source.name, target=placed.name,
+            tokens=tokens, bytes=size))
 
     def _harvest_external(self, handle: ReplicaHandle,
                           completed: list) -> None:
@@ -794,7 +1029,12 @@ class Router:
                     origin=route.handle, cause='timeout', route=route)
 
     def _hedge(self, route: _Route) -> None:
-        targets = self._targets(exclude=route.handle)
+        # a hedge leg runs the request end to end — prefill-only
+        # replicas cannot host it, so a split fleet hedges on the
+        # decode tier (which colocated replicas also belong to)
+        targets = self._targets(
+            exclude=route.handle,
+            role='decode' if self._split_roles else None)
         if not targets:
             return                   # nowhere to hedge
         target = targets[0]
@@ -872,14 +1112,35 @@ class Router:
 
     # -------------------------------------------------------- autoscale
 
+    def _pressured_role(self) -> str:
+        """Which tier of a split fleet needs the next replica: compare
+        prefill vs decode by (replicas backpressured, total queue
+        depth); undelivered handoffs count against the decode tier —
+        they are literally work with no decode seat. This is how the
+        autoscaler rebalances the prefill:decode ratio instead of
+        blindly growing whichever role ``provision`` defaults to."""
+        score = {'prefill': [0, 0], 'decode': [0, 0]}
+        for handle in self.healthy:
+            tier = 'prefill' if handle.role == 'prefill' else 'decode'
+            score[tier][0] += int(handle.backpressure)
+            score[tier][1] += handle.depth
+        score['decode'][1] += len(self._undelivered)
+        return max(('decode', 'prefill'),
+                   key=lambda tier: tuple(score[tier]))
+
     def _breathe(self) -> None:
         """Traffic-driven sizing: sustained backpressure (or orphaned
-        rows) grows the fleet through ``provision``; sustained full
-        idleness retires the emptiest replica through ``release``."""
+        rows, or undeliverable KV handoffs) grows the fleet through
+        ``provision``; sustained full idleness retires the emptiest
+        replica through ``release``. A split fleet grows the MORE
+        pressured tier (``provision(role=...)``, falling back to a
+        role-less ``provision()`` for legacy callables) and never
+        shrinks a tier to zero."""
         if self.autoscale is None:
             return
-        pressured = self.brownout or bool(self._orphans) or any(
-            handle.backpressure for handle in self.healthy)
+        pressured = (self.brownout or bool(self._orphans)
+                     or bool(self._undelivered)
+                     or any(handle.backpressure for handle in self.healthy))
         busy = bool(self._routes) or not all(
             handle.idle for handle in self.healthy)
         self._pressure_ticks = self._pressure_ticks + 1 if pressured else 0
@@ -890,7 +1151,15 @@ class Router:
         from tpusystem.observe.events import FleetResized
         if (pressured and self._pressure_ticks >= self.autoscale.grow_after
                 and len(self.healthy) < self.autoscale.max_replicas):
-            handle = self.adopt(self._provision())
+            if self._split_roles:
+                role = self._pressured_role()
+                try:
+                    replica = self._provision(role=role)
+                except TypeError:    # a role-blind provision callable
+                    replica = self._provision()
+            else:
+                replica = self._provision()
+            handle = self.adopt(replica)
             self._pressure_ticks = 0
             self._cooldown = self.autoscale.cooldown
             logger.info('fleet grew to %d replicas (+%r): sustained '
@@ -903,6 +1172,17 @@ class Router:
         if (self._idle_ticks >= self.autoscale.shrink_after
                 and len(self.healthy) > self.autoscale.min_replicas):
             idle = [handle for handle in self.healthy if handle.idle]
+            if self._split_roles:
+                # never shrink a tier to zero: a fleet with prompts but
+                # no prefill replica (or strips but no decode replica)
+                # deadlocks until the next grow
+                tiers: dict[str, int] = {}
+                for handle in self.healthy:
+                    tier = 'prefill' if handle.role == 'prefill' else 'decode'
+                    tiers[tier] = tiers.get(tier, 0) + 1
+                idle = [handle for handle in idle if tiers.get(
+                    'prefill' if handle.role == 'prefill' else 'decode',
+                    0) > 1]
             if not idle:
                 return               # never retire a replica holding work
             victim = idle[-1]        # newest-added idle replica goes back
@@ -922,6 +1202,7 @@ class Router:
     @property
     def idle(self) -> bool:
         return (not self._routes and not self._orphans
+                and not self._undelivered
                 and all(handle.idle for handle in self.healthy))
 
     def run_until_idle(self, max_steps: int = 10_000) -> dict:

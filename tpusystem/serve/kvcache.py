@@ -361,3 +361,30 @@ def write_tables(cache, tables):
                                     leaf.shape)
         return leaf
     return jax.tree_util.tree_map_with_path(fix, cache)
+
+
+def pool_shardings(cache, mesh):
+    """Per-leaf :class:`~jax.sharding.NamedSharding` for a paged pool
+    under a TP mesh — the mesh-aware half of the pool contract.
+
+    KV leaves ``[..., slots, heads, head_dim]`` shard over *heads* on the
+    ``model`` axis (each device holds its attention heads' blocks for
+    every slot — the same head split the TP matmuls already use, so
+    decode reads its KV locally). Heads that don't divide the axis fall
+    back replicated, the same divisibility discipline as
+    :meth:`~tpusystem.parallel.sharding.ShardingPolicy.spec`. Everything
+    else — block tables, cursors, masks — replicates: the host-side
+    :class:`PagedKVCache` stays the ONE block-table authority and
+    ``adopt_prefill``/``write_tables`` keep their contracts unchanged.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+    from tpusystem.parallel.mesh import MODEL
+    model = dict(mesh.shape).get(MODEL, 1)
+
+    def spec(path, leaf):
+        if _is_kv(path) and leaf.ndim >= 2 and leaf.shape[-2] % model == 0:
+            axes = [None] * leaf.ndim
+            axes[-2] = MODEL
+            return NamedSharding(mesh, PartitionSpec(*axes))
+        return NamedSharding(mesh, PartitionSpec())
+    return jax.tree_util.tree_map_with_path(spec, cache)

@@ -98,6 +98,10 @@ class _Pending:
     # replay path): the engine re-prefills prompt + prefix and the final
     # Completion is prefix + resumed tokens — token-exact under greedy
     prefix: list = dataclasses.field(default_factory=list)
+    # a KVHandoff when the prefill already ran on ANOTHER replica
+    # (disaggregated ingest): admission adopts the shipped strips via
+    # Engine.admit_prefilled instead of running a prefill program
+    handoff: object = None
 
 
 @dataclasses.dataclass
@@ -152,13 +156,19 @@ class Scheduler:
         watermarks: a :class:`~tpusystem.serve.failover.Watermarks`
             high/low pair for deadline-slack load shedding, or None
             (default: never shed).
+        prefill_only: the disaggregated prefill role — admission runs
+            :meth:`~tpusystem.serve.Engine.export_prefill` instead of
+            seating rows, finished strips land in :attr:`outbox` as
+            :class:`~tpusystem.serve.disagg.KVHandoff`\\ s (the router
+            ships them to a decode replica and acks with
+            :meth:`shipped`), and the decode phase never runs here.
     """
 
     def __init__(self, engine: Engine, *, prefill_budget: int = 512,
                  clock: Callable[[], float] = time.monotonic,
                  max_queued: int | None = None,
                  watermarks: Watermarks | None = None,
-                 tracer=None) -> None:
+                 tracer=None, prefill_only: bool = False) -> None:
         if max_queued is not None and max_queued < 1:
             raise ValueError(f'max_queued must be >= 1 (or None for '
                              f'unbounded), got {max_queued}')
@@ -166,12 +176,15 @@ class Scheduler:
         self.prefill_budget = prefill_budget
         self.max_queued = max_queued
         self.watermarks = watermarks
+        self.prefill_only = prefill_only
         self.journal: RequestJournal | None = None
         self.backpressure = False
         self.tracer = tracer         # observe.Tracer | None (None = zero
         self._clock = clock          # tracing work on every path below)
         self._queue: deque[_Pending] = deque()
         self._seated: dict[int, _Pending] = {}      # row -> pending
+        self.outbox: deque = deque()  # KVHandoffs awaiting shipment
+        self._shipping: dict[str, Request] = {}     # shipped, not yet acked
         self.results: dict[str, Completion] = {}
         self.steps = 0
         self._trace_open: dict[str, object] = {}    # request id -> Span
@@ -187,7 +200,10 @@ class Scheduler:
 
     @property
     def idle(self) -> bool:
-        return not self._queue and not self._seated
+        # a prefill replica with exported-but-unshipped strips is NOT
+        # idle, or the autoscaler could shrink it mid-handoff
+        return (not self._queue and not self._seated and not self.outbox
+                and not self._shipping)
 
     def submit(self, request: Request) -> None:
         """Queue a request. Requests that could NEVER fit (prompt +
@@ -244,12 +260,68 @@ class Scheduler:
                 f'request {request.id!r} already emitted {len(prefix)} of '
                 f'max_new={request.max_new} tokens — a finished request '
                 f'has no business in the journal')
+        if self.prefill_only and prefix:
+            from tpusystem.serve.disagg import RoleMismatch
+            raise RoleMismatch(
+                f'request {request.id!r} carries a {len(prefix)}-token '
+                'decode prefix but this scheduler is prefill-only — a hot '
+                'restore needs a decode-capable replica (the router '
+                'places by role; this raise is the safety net, not a '
+                'silent drop)')
         pending = _Pending(request, self._clock() - waited, prefix)
         self._queue.append(pending)
         if self.journal is not None:
             self.journal.restored(request, pending.submitted, prefix)
         if self.tracer is not None:
             self._trace_enqueue(request, prefix=len(prefix))
+
+    # ------------------------------------------------ disaggregated roles
+
+    def take_handoffs(self) -> list:
+        """Drain the prefill outbox — every
+        :class:`~tpusystem.serve.disagg.KVHandoff` exported since the
+        last call, in FIFO order. The caller (router or test harness)
+        ships each to a decode replica and acks with :meth:`shipped`;
+        until the ack the request counts as in flight here (journal row
+        live, :attr:`idle` false), so a crash between export and ack
+        recovers it."""
+        handoffs = list(self.outbox)
+        self.outbox.clear()
+        for handoff in handoffs:
+            self._shipping[handoff.request.id] = handoff.request
+        return handoffs
+
+    def shipped(self, request_id: str) -> None:
+        """Ack one handoff: the decode replica seated (or journaled) it,
+        so ownership transferred — this side's journal row closes and
+        its trace spans end with reason ``'handoff'``. Unknown ids are
+        ignored (the ack can race a local crash-recovery resubmit)."""
+        request = self._shipping.pop(request_id, None)
+        if self.journal is not None:
+            self.journal.finished(request_id)
+        if self.tracer is not None and request is not None:
+            self._trace_finish(request, 'handoff', 0)
+
+    def ingest(self, handoff, *, waited: float = 0.0) -> None:
+        """Decode-side entry: queue a request whose prefill ran on a
+        prefill-role replica. Admission seats it through
+        ``Engine.admit_prefilled`` (adopt-only — no prefill program
+        runs here). ``waited`` backdates the submission by the time the
+        request already spent on the prefill side, so deadlines and
+        latency accounting span the whole disaggregated path."""
+        request = handoff.request
+        prefix = [int(token) for token in handoff.prefix]
+        pending = _Pending(request, self._clock() - waited, prefix,
+                           handoff=handoff)
+        self._queue.append(pending)
+        if self.journal is not None:
+            if prefix:
+                self.journal.restored(request, pending.submitted, prefix)
+            else:
+                self.journal.record(request, pending.submitted)
+        if self.tracer is not None:
+            self._trace_enqueue(request,
+                                prefix=len(prefix) if prefix else None)
 
     # ------------------------------------------------------------ tracing
     # (every call below is guarded by `self.tracer is not None` at the
@@ -279,6 +351,15 @@ class Scheduler:
             'decode', cat='serve', trace=request.trace,
             args={'request': request.id, 'row': row})
 
+    def _trace_exported(self, request: Request) -> None:
+        """Close 'queued', open 'handoff' — ended by :meth:`shipped`'s
+        ack. Parented into ``request.trace`` like every serve span, so
+        the decode replica's spans and these share one trace."""
+        self.tracer.end(self._trace_open.pop(request.id, None))
+        self._trace_open[request.id] = self.tracer.begin(
+            'handoff', cat='serve', trace=request.trace,
+            args={'request': request.id})
+
     def _trace_finish(self, request: Request, reason: str,
                       produced: int) -> None:
         self.tracer.end(self._trace_open.pop(request.id, None),
@@ -306,6 +387,14 @@ class Scheduler:
                 del self._seated[row]
                 self._complete(pending, list(state.tokens), 'cancelled')
                 return 'active'
+        for handoff in list(self.outbox):
+            if handoff.request.id == request_id:
+                self.outbox.remove(handoff)
+                if self.journal is not None:
+                    self.journal.finished(request_id)
+                if self.tracer is not None:
+                    self._trace_finish(handoff.request, 'cancelled', 0)
+                return 'queued'
         return None
 
     def _expire(self) -> list:
@@ -410,16 +499,40 @@ class Scheduler:
             request = pending.request
             prompt = list(request.prompt) + pending.prefix
             remaining = request.max_new - len(pending.prefix)
-            cost = self.engine.admit_cost(prompt)
+            if pending.handoff is not None:
+                # adopt-only admission: the prefill already ran on the
+                # prefill-role replica — charge the floor, not the
+                # prompt bucket (the whole point of the split)
+                cost = self.engine.bucket(1)
+            else:
+                cost = self.engine.admit_cost(prompt)
             if cost > budget and budget < self.prefill_budget:
                 break                    # budget spent this step
+            if self.prefill_only:
+                self._queue.popleft()
+                first, kv = self.engine.export_prefill(prompt)
+                budget -= cost
+                from tpusystem.serve.disagg import KVHandoff
+                self.outbox.append(KVHandoff(
+                    request=request, first=first, kv=kv,
+                    prefix=list(pending.prefix),
+                    waited=self._clock() - pending.submitted))
+                if self.tracer is not None:
+                    self._trace_exported(request)
+                continue
             if not self.engine.can_admit(len(prompt), remaining,
                                          prompt=prompt):
                 break                    # FIFO: wait for rows/blocks
             self._queue.popleft()
-            admission = self.engine.admit(
-                prompt, remaining,
-                stop_token=request.stop_token, tag=request.id)
+            if pending.handoff is not None:
+                handoff, pending.handoff = pending.handoff, None
+                admission = self.engine.admit_prefilled(
+                    prompt, remaining, handoff.first, handoff.kv,
+                    stop_token=request.stop_token, tag=request.id)
+            else:
+                admission = self.engine.admit(
+                    prompt, remaining,
+                    stop_token=request.stop_token, tag=request.id)
             budget -= cost
             ttft = self._clock() - pending.submitted
             admitted.append((request, admission, ttft))

@@ -76,8 +76,16 @@ def fused_paged_reason(decoder) -> str | None:
     (:func:`build_fused_paged_step`) cannot run this decode clone, or
     ``None`` when it can. Unlike :func:`fused_unsupported_reason`, the
     paged step OWNS per-row cursors and the block-table scatter write —
-    the gates left are the step-math ones (GPT-2 dense, unrolled)."""
+    the gates left are the step-math ones (GPT-2 dense, unrolled) and
+    the TP mesh (no ring arms yet)."""
     from tpusystem.models.gpt2 import GPT2
+    mesh = getattr(decoder, 'mesh', None)
+    if mesh is not None and dict(getattr(mesh, 'shape', {})).get(
+            'model', 1) > 1:
+        return ('the fused paged step has no ring arms — its Pallas '
+                'matmuls are single-device; under a TP mesh '
+                "decode_impl='auto' serves through the sharded flax "
+                'paged step (token-exact vs single-device)')
     if not isinstance(decoder, GPT2):
         return ('the fused paged step implements the GPT2 family only '
                 f'(got {type(decoder).__name__})')
@@ -86,7 +94,8 @@ def fused_paged_reason(decoder) -> str | None:
                 'fused per-layer sweep does not walk')
     if decoder.moe_experts:
         return ('MoE blocks route through expert dispatch, not the FFN '
-                "chain — the engine's flax paged step serves MoE")
+                "chain — the engine's flax paged step serves MoE (full-"
+                'capacity decode dispatch), this fused chain does not')
     if not decoder.decode_pages:
         return ('no decode_pages on this clone — the paged step needs the '
                 "serving engine's block-pool cache layout")
