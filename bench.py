@@ -27,6 +27,9 @@ through the serving engine, ``benchmarks/serve_bench.py headline``),
 then the ``serve_shared_prefix_speedup`` row (radix prefix sharing on
 a shared-system-prompt workload vs no sharing,
 ``benchmarks/serve_bench.py shared``),
+then the ``serve_sampled_tok_s`` row (seeded top-k/top-p sampling vs
+greedy on the same compiled step, determinism asserted bitwise every
+trial, ``benchmarks/serve_bench.py sampled``),
 then the ``serve_recovery_seconds`` row (kill -> first replayed token
 through the serving failover layer, hot journal replay vs cold
 re-submit, ``benchmarks/serve_recovery.py headline``),
@@ -220,6 +223,18 @@ def serve_shared_prefix_row() -> None:
     token-exact against standalone ``generate()``)."""
     _overlap_probe_row('serve_bench.py', 'serve_shared_prefix_speedup',
                        arg='shared')
+
+
+def serve_sampled_row() -> None:
+    """The seeded-sampling row: delivered tok/s with per-request seeded
+    top-k/top-p ``SamplingParams`` vs greedy on the same mixed workload
+    and the SAME compiled step (`benchmarks/serve_bench.py sampled`;
+    the counter-based sampling of `tpusystem/serve/engine.py` — every
+    timed trial is re-run with the same seeds and asserted bitwise-
+    identical, the determinism every replay/reroute/hedge guarantee
+    rides on)."""
+    _overlap_probe_row('serve_bench.py', 'serve_sampled_tok_s',
+                       arg='sampled')
 
 
 def serve_recovery_row() -> None:
@@ -638,6 +653,7 @@ if __name__ == '__main__':
     decode_rows()
     serve_row()
     serve_shared_prefix_row()
+    serve_sampled_row()
     serve_recovery_row()
     fleet_recovery_row()
     serve_disagg_ttft_row()

@@ -41,9 +41,17 @@ TPU protocol uses the 125M decode config); the *ratios* are the
 architectural claims: continuous batching >= 2x static, and sharing
 >= 1.5x no-sharing delivered tok/s on the shared-prompt workload.
 
-Run: ``python benchmarks/serve_bench.py [headline|shared]`` —
+A fourth arm measures **seeded sampling** (``sampled``): the same mixed
+workload served greedy vs with per-request seeded top-k/top-p
+``SamplingParams`` on ONE engine (one compiled trace for both arms) —
+the cost of counter-based sampling inside the compiled step, with
+determinism asserted bitwise every trial (each timed pass is re-run
+with the same seeds and compared token-for-token).
+
+Run: ``python benchmarks/serve_bench.py [headline|shared|sampled]`` —
 ``shared`` prints only the prefix-sharing section (its last line is the
-``serve_shared_prefix_speedup`` row ``bench.py`` forwards).
+``serve_shared_prefix_speedup`` row ``bench.py`` forwards); ``sampled``
+prints only the sampling section (last line ``serve_sampled_tok_s``).
 """
 
 from __future__ import annotations
@@ -60,7 +68,7 @@ import numpy as np
 
 from bench import materialize
 from tpusystem.models import GPT2, gpt2_tiny
-from tpusystem.serve import Engine, Request, Scheduler
+from tpusystem.serve import Engine, Request, SamplingParams, Scheduler
 from tpusystem.train import generate
 
 TRIALS = 3
@@ -238,11 +246,71 @@ def shared_section() -> None:
         'workload': workload}))
 
 
+def sampled_arm(engine, prompts, budgets, sampling) -> tuple[float, int]:
+    """Median wall seconds for the workload with ``sampling(index)``
+    per request (None entries = greedy), plus delivered tokens. EVERY
+    trial runs the workload twice and asserts the two passes bitwise-
+    identical — the determinism contract is measured under the clock,
+    not assumed (the second pass is outside the timed window)."""
+
+    def run_once() -> dict:
+        scheduler = Scheduler(engine)
+        for index, (prompt, budget) in enumerate(zip(prompts, budgets)):
+            scheduler.submit(Request(f'r{index}', list(prompt), budget,
+                                     sampling=sampling(index)))
+        return {rid: list(c.tokens) for rid, c in scheduler.run().items()}
+
+    run_once()                                   # warm/compile
+    trials = []
+    for _ in range(TRIALS):
+        start = time.perf_counter()
+        first = run_once()
+        trials.append(time.perf_counter() - start)
+        again = run_once()                       # same seeds -> same bits
+        assert first == again, 'sampled decode was not deterministic'
+    return sorted(trials)[len(trials) // 2], sum(budgets)
+
+
+def sampled_section() -> None:
+    """Sampled vs greedy delivered tok/s on the mixed workload — the
+    cost of per-row seeded top-k/top-p sampling inside the one compiled
+    step (same engine, same trace), with determinism asserted every
+    trial. LAST line = ``serve_sampled_tok_s`` (``bench.py`` forwards
+    it)."""
+    module, params, prompts, budgets = recipe()
+    engine = Engine(module, params, rows=ROWS,
+                    block_size=16 if ON_TPU else 8)
+    greedy_seconds, tokens = sampled_arm(engine, prompts, budgets,
+                                         lambda index: None)
+    sampled_seconds, _ = sampled_arm(
+        engine, prompts, budgets,
+        lambda index: SamplingParams(seed=100 + index, temperature=0.9,
+                                     top_k=64, top_p=0.95))
+    assert engine.trace_count == 1, engine.trace_count
+    greedy_tok_s = tokens / greedy_seconds
+    sampled_tok_s = tokens / sampled_seconds
+    workload = (f'{len(prompts)} reqs, prompts '
+                f'{sorted(set(len(p) for p in prompts))}, budgets '
+                f'{sorted(set(budgets))}, rows {ROWS}')
+    print(json.dumps({
+        'metric': 'serve_sampled_tok_s',
+        'value': round(sampled_tok_s, 1),
+        'unit': f'tok/s delivered, seeded top-k/top-p ({workload})'
+                + ('' if ON_TPU else ' [CPU smoke]'),
+        'greedy_tok_s': round(greedy_tok_s, 1),
+        'sampled_over_greedy': round(sampled_tok_s / greedy_tok_s, 2),
+        'determinism': 'asserted bitwise every trial'}))
+
+
 def main() -> None:
     if 'shared' in sys.argv[1:]:
         shared_section()         # LAST line = serve_shared_prefix_speedup
         return
+    if 'sampled' in sys.argv[1:]:
+        sampled_section()        # LAST line = serve_sampled_tok_s
+        return
     shared_section()
+    sampled_section()
     module, params, prompts, budgets = recipe()
     static_seconds, tokens = static_arm(module, params, prompts, budgets)
     continuous_seconds, _, phases = continuous_arm(module, params, prompts,

@@ -171,6 +171,9 @@ TB_EXEMPT = {
                             # state; completions/expiries carry the charts
     'RequestReplayed',      # EngineRestarted charts replayed/resubmitted
                             # counts; per-row detail lives on the trace
+    'TokenStreamed',        # per-token volume would swamp the board;
+                            # TTFT and latency ride RequestAdmitted /
+                            # RequestCompleted, throughput ServeStepped
     'WorkerRelaunched',     # WorkerExited's per-rank exit chart already
                             # counts every relaunch verdict
     'WorldResizeProposed',  # proposals can outnumber commits under churn;

@@ -1,0 +1,525 @@
+"""Deterministic sampled, structured, and streaming decode
+(tpusystem/serve/engine.py sampling + tpusystem/serve/service.py
+streaming).
+
+The contract under drill: seeded counter-based sampling makes sampled
+decode exactly as reproducible as greedy — the token at stream position
+``p`` is a pure function of ``(seed, p)`` and the logits, with no RNG
+state beyond the emitted prefix — so every robustness move the serving
+tier already owns (journal replay after SIGKILL, fleet reroute onto a
+different engine, hedged duplicates) stays BITWISE-exact with sampling
+on. Per-request SamplingParams ride the one compiled step as batched
+device arrays (trace_count stays 1 across churn), grammar masks
+constrain the same step, the one non-reproducible configuration
+(unseeded sampling) is refused typed at every front door, and streaming
+delivers each token the step it materializes — truthful about partial
+output under cancel and deadline expiry.
+"""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpusystem.checkpoint.memstore import MemStore
+from tpusystem.models import gpt2_tiny
+from tpusystem.parallel.chaos import PreemptionWave
+from tpusystem.parallel.multihost import _blob_digest
+from tpusystem.serve import (Engine, InferenceService, ReplicaHandle,
+                             Request, RequestJournal, RoutePolicy, Router,
+                             SamplingParams, Scheduler, ServingReplica,
+                             UnseededSampling, replay)
+from tpusystem.train import generate
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture(scope='module')
+def served():
+    module = gpt2_tiny(dtype='float32')
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (1, 8)), jnp.int32)
+    params = module.init(jax.random.PRNGKey(0), prompt)['params']
+    return module, params
+
+
+SAMPLED = SamplingParams(seed=11, temperature=0.9, top_k=16, top_p=0.95)
+
+
+def greedy_reference(module, params, prompt, steps):
+    out = generate(module, params, jnp.asarray(prompt, jnp.int32)[None],
+                   steps=steps)
+    return [int(t) for t in np.asarray(out)[0, len(prompt):]]
+
+
+def sampled_reference(module, params, prompt, steps, sampling,
+                      **engine_knobs):
+    """The sampled parity oracle: one request on a fresh engine,
+    uninterrupted — what every drilled path must reproduce bitwise."""
+    knobs = dict(rows=2, block_size=8)
+    knobs.update(engine_knobs)
+    scheduler = Scheduler(Engine(module, params, **knobs))
+    scheduler.submit(Request('ref', list(prompt), steps, sampling=sampling))
+    return scheduler.run()['ref'].tokens
+
+
+# ---------------------------------------------------------------------------
+# SamplingParams: validation and the typed refusals
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_params_validation():
+    assert not SamplingParams().sampled                   # default = greedy
+    assert SamplingParams(seed=1, temperature=0.5).sampled
+    with pytest.raises(ValueError, match='temperature'):
+        SamplingParams(temperature=-0.1)
+    with pytest.raises(ValueError, match='top_k'):
+        SamplingParams(top_k=-1)
+    with pytest.raises(ValueError, match='top_p'):
+        SamplingParams(top_p=0.0)
+    with pytest.raises(ValueError, match='top_p'):
+        SamplingParams(top_p=1.5)
+
+
+def test_unseeded_sampled_refused_typed_at_scheduler(served):
+    """Sampled decode without a seed is the ONE non-reproducible
+    configuration — refused typed at submit, before any device work, and
+    the refusal leaves the engine perfectly serviceable."""
+    module, params = served
+    scheduler = Scheduler(Engine(module, params, rows=1, block_size=8))
+    prompt = list(np.random.default_rng(2).integers(0, 256, (5,)))
+    unseeded = SamplingParams(temperature=0.8)
+    with pytest.raises(UnseededSampling, match='seed'):
+        scheduler.submit(Request('bad', prompt, 4, sampling=unseeded))
+    with pytest.raises(UnseededSampling):
+        scheduler.engine.admit(prompt, 4, sampling=unseeded)
+    assert isinstance(UnseededSampling('x'), ValueError)  # fleet contract
+    scheduler.submit(Request('ok', prompt, 4))
+    assert scheduler.run()['ok'].tokens == greedy_reference(
+        module, params, prompt, 4)
+
+
+def test_unseeded_sampled_refused_at_fleet_front_door():
+    """The router refuses an unseeded sampled request BEFORE placement —
+    no replica is ever touched (the stub would explode if one were)."""
+    class _Stub:
+        identity = 'stub'
+        client = None
+        fallbacks = ()
+        scheduler = None
+
+    router = Router([ReplicaHandle(_Stub())])
+    with pytest.raises(UnseededSampling, match='seed'):
+        router.submit(Request('bad', [1, 2], 4,
+                              sampling=SamplingParams(temperature=1.0)))
+
+
+# ---------------------------------------------------------------------------
+# the compiled step: compile-once, determinism, greedy purity
+# ---------------------------------------------------------------------------
+
+
+def test_sampling_churn_never_retraces_and_greedy_stays_bitwise(served):
+    """Per-request SamplingParams are batched device arrays, not trace
+    constants: seed/temperature/top-k/top-p churn across admissions
+    keeps trace_count == 1, and a greedy row co-batched with sampled
+    neighbors emits EXACTLY its standalone greedy stream."""
+    module, params = served
+    rng = np.random.default_rng(5)
+    prompts = [list(rng.integers(0, 256, (n,))) for n in (5, 7, 6)]
+    greedy_ref = greedy_reference(module, params, prompts[0], 8)
+    engine = Engine(module, params, rows=2, block_size=8)
+    scheduler = Scheduler(engine)
+    scheduler.submit(Request('greedy', prompts[0], 8))
+    scheduler.submit(Request('s1', prompts[1], 8, sampling=SAMPLED))
+    scheduler.submit(Request('s2', prompts[2], 8, sampling=SamplingParams(
+        seed=3, temperature=1.3, top_k=0, top_p=0.8)))
+    results = scheduler.run()
+    assert results['greedy'].tokens == greedy_ref
+    assert engine.trace_count == 1, (
+        f'sampling churn retraced the decode step: {engine.trace_count}')
+
+
+def test_same_seed_is_bitwise_reproducible_across_engines(served):
+    """Two independent engines, same seed → the identical stream; a
+    different seed diverges (sampling is real, not greedy in disguise)."""
+    module, params = served
+    prompt = list(np.random.default_rng(7).integers(0, 256, (6,)))
+    first = sampled_reference(module, params, prompt, 10, SAMPLED)
+    again = sampled_reference(module, params, prompt, 10, SAMPLED)
+    other = sampled_reference(module, params, prompt, 10,
+                              SamplingParams(seed=12, temperature=0.9,
+                                             top_k=16, top_p=0.95))
+    assert first == again
+    assert first != other
+    assert first != greedy_reference(module, params, prompt, 10)
+
+
+def test_top_k_one_matches_greedy(served):
+    module, params = served
+    prompt = list(np.random.default_rng(9).integers(0, 256, (5,)))
+    narrowed = sampled_reference(
+        module, params, prompt, 6,
+        SamplingParams(seed=4, temperature=2.0, top_k=1))
+    assert narrowed == greedy_reference(module, params, prompt, 6)
+
+
+# ---------------------------------------------------------------------------
+# grammar masks: the structured-decode hook in the same compiled step
+# ---------------------------------------------------------------------------
+
+
+def test_grammar_mask_constrains_sampled_and_greedy_rows(served):
+    module, params = served
+    vocab = module.vocab_size
+    even = np.zeros(vocab, bool)
+    even[::2] = True
+
+    def even_mask(emitted):
+        return even
+
+    prompt = list(np.random.default_rng(13).integers(0, 256, (5,)))
+    engine = Engine(module, params, rows=2, block_size=8)
+    scheduler = Scheduler(engine)
+    scheduler.submit(Request('sg', prompt, 6, sampling=SamplingParams(
+        seed=8, temperature=0.9, mask_fn=even_mask)))
+    scheduler.submit(Request('gg', prompt, 6, sampling=SamplingParams(
+        mask_fn=even_mask)))                     # greedy under the grammar
+    results = scheduler.run()
+    for rid in ('sg', 'gg'):
+        assert all(t % 2 == 0 for t in results[rid].tokens), rid
+    assert engine.trace_count == 1
+    # the structured streams actually obeyed the mask (not vacuous)
+    assert any(t % 2 for t in greedy_reference(module, params, prompt, 6))
+
+
+def test_grammar_mask_dead_end_and_spec_composition_refused(served):
+    module, params = served
+    engine = Engine(module, params, rows=1, block_size=8)
+
+    def dead_end(emitted):
+        return np.zeros(module.vocab_size, bool)
+
+    with pytest.raises(ValueError, match='mask'):
+        engine.admit([1, 2, 3], 4,
+                     sampling=SamplingParams(mask_fn=dead_end))
+    spec = Engine(module, params, rows=2, block_size=8,
+                  draft_module=module, draft_params=params, speculate=2)
+    with pytest.raises(ValueError, match='speculative'):
+        spec.admit([1, 2, 3], 4, sampling=SamplingParams(
+            mask_fn=lambda emitted: np.ones(module.vocab_size, bool)))
+
+
+# ---------------------------------------------------------------------------
+# replay: SIGKILL mid-sample -> journal -> bitwise-equal completions
+# ---------------------------------------------------------------------------
+
+
+def test_kill_mid_sample_replay_is_bitwise(served):
+    """THE acceptance drill, in-process form: a replica serving sampled
+    + greedy traffic dies mid-stream (objects abandoned; only the
+    replicated journal survives); the relaunch replays hot from the
+    emitted prefix and every completion — sampled included — is
+    BITWISE-equal to an uninterrupted reference, on ONE compiled trace."""
+    module, params = served
+    rng = np.random.default_rng(17)
+    prompts = [list(rng.integers(0, 256, (n,))) for n in (6, 5)]
+    specs = [('samp', prompts[0], 8, SAMPLED),
+             ('greedy', prompts[1], 6, None)]
+
+    def build():
+        return Scheduler(Engine(module, params, rows=2, block_size=8))
+
+    uninterrupted = build()
+    for rid, prompt, budget, sampling in specs:
+        uninterrupted.submit(Request(rid, prompt, budget, sampling=sampling))
+    refs = {rid: c.tokens for rid, c in uninterrupted.run().items()}
+
+    store = MemStore()
+    replica = ServingReplica(build, identity='drill', client=store,
+                             cadence=1)
+    for rid, prompt, budget, sampling in specs:
+        replica.submit(Request(rid, prompt, budget, sampling=sampling))
+    for _ in range(3):
+        replica.step()              # mid-sample: prefixes journaled out
+    relaunched = ServingReplica(build, identity='drill', client=store,
+                                cadence=1)
+    assert relaunched.recovered
+    assert 'samp' in relaunched.report.replayed        # hot, mid-stream
+    results = relaunched.run_until_idle()
+    for rid, _prompt, _budget, _sampling in specs:
+        assert results[rid].tokens == refs[rid], f'{rid} diverged'
+        assert results[rid].reason == 'length'
+    assert relaunched.scheduler.engine.trace_count == 1
+
+
+def test_pre_sampling_journal_blob_reads_as_greedy(served):
+    """Wire compatibility regression: a journal packed BEFORE sampling
+    existed (its pickled requests carry no ``sampling`` attribute at
+    all) unpacks with ``sampling = None`` and replays token-exact as
+    greedy — an upgrade mid-incident never crashes on the old format."""
+    module, params = served
+    prompt = list(np.random.default_rng(19).integers(0, 256, (5,)))
+    ref = greedy_reference(module, params, prompt, 6)
+
+    request = Request('old', prompt, 6)
+    del request.__dict__['sampling']       # the pre-sampling pickle shape
+    payload = pickle.dumps(
+        (4, [(request, 2.5, list(ref[:2]))]),
+        protocol=pickle.HIGHEST_PROTOCOL)
+    blob = _blob_digest(payload).encode('ascii') + b':' + payload
+
+    tick, rows = RequestJournal.unpack(blob)
+    assert tick == 4
+    restored = rows[0][0]
+    assert 'sampling' in vars(restored) and restored.sampling is None
+
+    scheduler = Scheduler(Engine(module, params, rows=1, block_size=8))
+    report = replay(scheduler, rows)
+    assert report.replayed == ['old']
+    assert scheduler.run()['old'].tokens == ref
+
+
+# ---------------------------------------------------------------------------
+# the fleet: reroute and hedging stay bitwise with sampling on
+# ---------------------------------------------------------------------------
+
+
+def _fleet(module, params, clock, n=2):
+    stores = [MemStore() for _ in range(n)]
+    handles = []
+    for i in range(n):
+        def build():
+            return Scheduler(Engine(module, params, rows=2, block_size=8),
+                             clock=clock)
+        handles.append(ReplicaHandle(ServingReplica(
+            build, identity=f'rep{i}', client=stores[i], cadence=1,
+            clock=clock)))
+    return Router(handles, clock=clock), handles
+
+
+@pytest.mark.slow
+def test_fleet_reroute_mid_sample_is_bitwise(served):
+    """The SIGKILL drill across the fleet: a replica dies mid-sample,
+    the journal hands its rows to a DIFFERENT engine, and the sampled
+    completions are bitwise-equal to an uninterrupted fleet — the
+    counter needs nothing from the dead engine but the emitted prefix."""
+    module, params = served
+    rng = np.random.default_rng(23)
+    specs = [('s0', list(rng.integers(0, 256, (6,))), 9, SAMPLED),
+             ('s1', list(rng.integers(0, 256, (5,))), 8, SamplingParams(
+                 seed=29, temperature=1.1, top_p=0.9)),
+             ('g0', list(rng.integers(0, 256, (7,))), 8, None)]
+
+    reference_router, _ = _fleet(module, params, FakeClock(), n=2)
+    for rid, prompt, budget, sampling in specs:
+        reference_router.submit(Request(rid, prompt, budget,
+                                        sampling=sampling))
+    reference = reference_router.run_until_idle()
+
+    router, handles = _fleet(module, params, FakeClock(), n=2)
+    for rid, prompt, budget, sampling in specs:
+        router.submit(Request(rid, prompt, budget, sampling=sampling))
+    wave = PreemptionWave(step=2, kills=(handles[0].kill,))
+    moved = []
+    for _ in range(200):
+        if router.idle:
+            break
+        wave(router.ticks + 1)
+        moved += [e for e in router.step().rerouted if e.cause == 'failover']
+    assert router.idle and wave.fired and not handles[0].healthy
+    assert any(e.where == 'hot' for e in moved)    # seated rows moved hot
+    assert set(router.results) == set(reference)
+    for rid, completion in router.results.items():
+        assert completion.tokens == reference[rid].tokens, rid
+
+
+@pytest.mark.slow
+def test_hedged_sampled_duplicates_emit_identical_streams(served):
+    """Hedging with sampling on: the duplicate leg runs the SAME seeded
+    counter, so by the time first-completion-wins cancels the loser, the
+    loser's partial stream is a bitwise prefix of the winner's — the
+    race can never surface two different answers."""
+    module, params = served
+    prompt = list(np.random.default_rng(31).integers(0, 256, (5,)))
+    ref = sampled_reference(module, params, prompt, 8, SAMPLED)
+    clock = FakeClock()
+    stores = [MemStore(), MemStore()]
+    handles = []
+    for i in range(2):
+        def build():
+            return Scheduler(Engine(module, params, rows=2, block_size=8),
+                             clock=clock)
+        handles.append(ReplicaHandle(ServingReplica(
+            build, identity=f'rep{i}', client=stores[i], cadence=1,
+            clock=clock)))
+    router = Router(handles, clock=clock,
+                    policy=RoutePolicy(hedge_after=5.0))
+    origin = router.submit(Request('h', prompt, 8, sampling=SAMPLED))
+    router.step()
+    clock.advance(6.0)
+    tick = router.step()               # the duplicate fires
+    hedges = [e for e in tick.rerouted if e.cause == 'hedge']
+    assert hedges and hedges[0].target != origin
+    results = router.run_until_idle()
+    assert results['h'].tokens == ref and results['h'].reason == 'length'
+    loser_name = hedges[0].target
+    loser = next(h for h in handles if h.name == loser_name)
+    partial = loser.scheduler.results['h']
+    assert partial.reason == 'cancelled'
+    assert 0 < len(partial.tokens) < len(ref)
+    assert partial.tokens == ref[:len(partial.tokens)]   # identical stream
+
+
+# ---------------------------------------------------------------------------
+# speculative rows and disaggregated prefill under sampling
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_sampled_matches_plain_and_stops_in_window(served):
+    """Draft/verify under sampling: greedy drafts are accepted only
+    where they equal the seeded sampled targets, so the speculative
+    stream is BITWISE the sequential sampled stream — including a stop
+    token that lands mid-window (truncated at the stop, never past)."""
+    module, params = served
+    prompt = list(np.random.default_rng(37).integers(0, 256, (6,)))
+    ref = sampled_reference(module, params, prompt, 10, SAMPLED)
+    spec = Engine(module, params, rows=2, block_size=8,
+                  draft_module=module, draft_params=params, speculate=3)
+    scheduler = Scheduler(spec)
+    scheduler.submit(Request('full', prompt, 10, sampling=SAMPLED))
+    stop = ref[4]
+    first_hit = ref.index(stop)
+    scheduler.submit(Request('stopped', prompt, 10, stop_token=stop,
+                             sampling=SAMPLED))
+    results = scheduler.run()
+    assert results['full'].tokens == ref
+    assert results['full'].reason == 'length'
+    assert results['stopped'].tokens == ref[:first_hit + 1]
+    assert results['stopped'].reason == 'stop'
+
+
+def test_disagg_sampled_first_token_is_role_invariant(served):
+    """Disaggregated prefill under sampling: the prefill replica's
+    exported first token samples at the SAME ``(seed, position)``
+    counter the decode replica would use, so the handed-off stream is
+    bitwise the colocated one."""
+    module, params = served
+    prompt = list(np.random.default_rng(41).integers(0, 256, (5,)))
+    ref = sampled_reference(module, params, prompt, 7, SAMPLED)
+    prefiller = Engine(module, params, rows=1, block_size=8)
+    first, kv = prefiller.export_prefill(prompt, sampling=SAMPLED)
+    assert first == ref[0]
+    decoder = Engine(module, params, rows=1, block_size=8)
+    decoder.admit_prefilled(prompt, 7, first, kv, sampling=SAMPLED)
+    tokens = None
+    while decoder.active_rows:
+        for _row, reason, out in decoder.step().finished:
+            tokens = out
+    assert tokens == ref
+
+
+# ---------------------------------------------------------------------------
+# streaming: incremental delivery, truthful under cancel and expiry
+# ---------------------------------------------------------------------------
+
+
+def _witness(producer, *event_types):
+    from tpusystem.services.prodcon import Consumer
+    seen = []
+    consumer = Consumer('probe')
+    for event_type in event_types:
+        consumer.register(event_type, seen.append)
+    producer.register(consumer)
+    return seen
+
+
+def test_streaming_delivers_each_token_and_narrates(served):
+    """``submit(..., on_token=)``: index 0 arrives at admission (its
+    latency IS the charted TTFT), later tokens one step each; the full
+    delivered stream equals the completion bitwise; every delivery is a
+    TokenStreamed event and ServeStepped gauges the sampled rows."""
+    from tpusystem.observe.events import ServeStepped, TokenStreamed
+    from tpusystem.services.prodcon import Producer
+
+    module, params = served
+    producer = Producer()
+    streamed = _witness(producer, TokenStreamed)
+    stepped = _witness(producer, ServeStepped)
+    service = InferenceService(module, params, producer=producer, rows=2,
+                               block_size=8)
+    prompt = list(np.random.default_rng(43).integers(0, 256, (5,)))
+    delivered = []
+    service.submit(Request('s', prompt, 6, sampling=SAMPLED),
+                   on_token=lambda index, token: delivered.append(
+                       (index, token)))
+    service.submit(Request('quiet', prompt, 4))      # non-streaming
+    results = service.run_until_idle()
+    assert [i for i, _ in delivered] == list(range(6))
+    assert [t for _, t in delivered] == results['s'].tokens
+    assert [(e.index, e.token) for e in streamed] == delivered
+    assert {e.id for e in streamed} == {'s'}         # quiet stays quiet
+    assert max(e.sampled for e in stepped) == 1      # the sampled gauge
+    assert stepped[-1].sampled == 0                  # drained
+
+
+def test_cancel_mid_stream_keeps_delivered_tokens(served):
+    module, params = served
+    service = InferenceService(module, params, rows=1, block_size=8)
+    prompt = list(np.random.default_rng(47).integers(0, 256, (5,)))
+    delivered = []
+    service.submit(Request('c', prompt, 20),
+                   on_token=lambda index, token: delivered.append(token))
+    for _ in range(3):
+        service.step()
+    frozen = list(delivered)
+    assert 0 < len(frozen) < 20
+    assert service.cancel('c') == 'active'
+    for _ in range(3):
+        service.step()
+    assert delivered == frozen                  # stream went silent
+    assert service.results['c'].tokens == frozen  # nothing un-delivered
+
+
+def test_deadline_expiry_mid_stream_is_truthful_about_partials(served):
+    """A streaming request whose deadline passes mid-decode keeps every
+    token delivered before the expiry, and the ``expired`` verdict's
+    ``produced`` equals exactly what the consumer saw — no more, no
+    less."""
+    from tpusystem.observe.events import RequestExpired
+    from tpusystem.services.prodcon import Producer
+
+    module, params = served
+    clock = FakeClock()
+    producer = Producer()
+    expired = _witness(producer, RequestExpired)
+    service = InferenceService(module, params, producer=producer, rows=1,
+                               block_size=8, clock=clock)
+    prompt = list(np.random.default_rng(53).integers(0, 256, (4,)))
+    delivered = []
+    service.submit(Request('d', prompt, 30, deadline=5.0,
+                           sampling=SAMPLED),
+                   on_token=lambda index, token: delivered.append(token))
+    for _ in range(3):
+        service.step()
+    assert delivered
+    clock.advance(10.0)
+    service.step()
+    assert expired and expired[0].id == 'd'
+    assert expired[0].where == 'active'
+    assert expired[0].produced == len(delivered)
+    frozen = list(delivered)
+    service.step()
+    assert delivered == frozen
+    assert service.results['d'].tokens == frozen

@@ -98,7 +98,11 @@ class FakeEngine:
                   prompt=None) -> bool:
         return len(self.active) < self.rows
 
-    def admit(self, prompt, remaining, stop_token=None, tag=None):
+    def _validate_sampling(self, sampling) -> None:
+        pass                             # greedy-only fake
+
+    def admit(self, prompt, remaining, stop_token=None, tag=None,
+              sampling=None, emitted=()):
         row = next(r for r in range(self.rows) if r not in self.active)
         base = 1000 + len(prompt)
         if remaining == 1:

@@ -161,12 +161,28 @@ class RequestExpired:
 @event
 class ServeStepped:
     """One scheduler iteration: current batch occupancy and queue depth,
-    plus the sliding tokens-per-second the engine is sustaining."""
+    plus the sliding tokens-per-second the engine is sustaining.
+    ``sampled`` is how many seated rows decode with ``temperature > 0``
+    (the sampled-traffic gauge; 0 = all-greedy)."""
     step: int
     active: int
     queue_depth: int
     emitted: int
     tokens_per_sec: float
+    sampled: int = 0
+
+
+@event
+class TokenStreamed:
+    """One token delivered incrementally to a streaming consumer
+    (:meth:`tpusystem.serve.InferenceService.submit` with ``on_token``):
+    ``index`` is the token's position in the request's stream (0 = the
+    first token, whose latency IS the admission's ``ttft``). Fires per
+    token of streaming requests only — non-streaming traffic keeps its
+    per-step ``ServeStepped.emitted`` aggregate."""
+    id: str
+    index: int
+    token: int
 
 
 @event
@@ -196,9 +212,10 @@ class RequestReplayed:
     """An engine relaunch re-queued a journaled request: ``prefix`` is
     how many already-emitted tokens replay re-prefills (``where='hot'``)
     before decode resumes; 0 / ``where='cold'`` is the re-submit of a
-    request the journal only knew as queued. Greedy decode is
-    deterministic, so either way the final completion is token-exact
-    against an uninterrupted run."""
+    request the journal only knew as queued. Greedy and seeded sampled
+    decode are both deterministic (the sampling counter is a pure
+    function of ``(seed, position)``), so either way the final
+    completion is token-exact against an uninterrupted run."""
     id: str
     prefix: int
     where: str                       # 'hot' | 'cold'
@@ -225,8 +242,9 @@ class RequestRerouted:
     duplicate racing the straggler; first completion wins). ``where`` /
     ``prefix`` follow ``RequestReplayed``'s convention: a hot move
     re-prefills ``prefix`` already-emitted tokens on the target engine
-    and resumes; greedy decode keeps the final completion token-exact
-    across the move."""
+    and resumes; greedy and seeded sampled decode alike keep the final
+    completion token-exact across the move (hedged sampled duplicates
+    emit the identical stream on both legs)."""
     id: str
     origin: str
     target: str

@@ -249,6 +249,10 @@ def tensorboard_consumer() -> Consumer:
         board.add_scalar('serve/active_rows', float(event.active),
                          event.step)
         board.add_scalar('serve/tok_s', event.tokens_per_sec, event.step)
+        # sampled-traffic gauge (getattr: replayed event streams may
+        # carry pre-sampling ServeStepped payloads without the field)
+        board.add_scalar('serve/sampled_rows',
+                         float(getattr(event, 'sampled', 0)), event.step)
 
     # deadline expiries: charted against an expiry counter (requests have
     # no global step), split by where the request died — a queue full of

@@ -18,7 +18,11 @@ Layers, bottom up:
 * :class:`Engine` — the fixed-shape compiled decode step with
   admit/evict row churn; ``decode_impl='fused'`` routes it through the
   Pallas fused decode chain and ``draft_module=`` turns rows into
-  speculative draft/verify groups (:mod:`tpusystem.serve.engine`);
+  speculative draft/verify groups. Per-request :class:`SamplingParams`
+  ride the same compiled step as batched device arrays (seeded
+  counter-based sampling + grammar vocab masks, compile-once across
+  param churn; :exc:`UnseededSampling` is the typed refusal of the one
+  non-reproducible configuration) (:mod:`tpusystem.serve.engine`);
 * :class:`Scheduler` / :class:`Request` — prefill/decode phase packing
   under a token budget (:mod:`tpusystem.serve.scheduler`);
 * :class:`InferenceService` — the command/event bus front door
@@ -50,8 +54,10 @@ from tpusystem.serve.disagg import (HandoffCorrupt, KVHandoff, KVStripStore,
                                     RoleMismatch, fetch_handoff,
                                     kv_namespace, pack_handoff,
                                     unpack_handoff)
-from tpusystem.serve.engine import (Admission, Engine, Saturated,
-                                    StepReport, engine_unsupported_reason,
+from tpusystem.serve.engine import (Admission, Engine, SamplingParams,
+                                    Saturated, StepReport,
+                                    UnseededSampling,
+                                    engine_unsupported_reason,
                                     prefill_bucket)
 from tpusystem.serve.failover import (EngineStalled, JournalCorrupt,
                                       ReplayReport, RequestJournal,
@@ -70,6 +76,7 @@ from tpusystem.serve.scheduler import (Completion, QueueFull, Request,
 from tpusystem.serve.service import InferenceService
 
 __all__ = ['Engine', 'Admission', 'StepReport', 'Saturated',
+           'SamplingParams', 'UnseededSampling',
            'engine_unsupported_reason', 'prefill_bucket',
            'PagedKVCache', 'TRASH_BLOCK', 'adopt_prefill', 'write_tables',
            'Scheduler', 'Request', 'Completion', 'Tick', 'serve_levers',

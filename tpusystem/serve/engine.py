@@ -1,6 +1,6 @@
 """Continuous-batching decode engine: one compiled step, churning rows.
 
-The engine runs a fixed-shape ``[rows, 1]`` greedy token-step under
+The engine runs a fixed-shape ``[rows, 1]`` token-step under
 ``jit`` — the ``per_row_decode`` discipline from the speculative path
 (:mod:`tpusystem.train.generate`), extended to independent user
 sequences over the paged KV cache
@@ -48,11 +48,24 @@ The decode-roofline levers compose on top of that contract:
   request owns ``tree_fanout`` adjacent branch rows of the same paged
   pool; the draft fans/extends each branch ``speculate`` tokens and ONE
   target forward verifies every branch window, emitting the longest
-  target-greedy-accepted prefix plus one corrected token per request —
+  target-accepted prefix plus one corrected token per request —
   between 1 and ``speculate + 1`` tokens per step, still exactly the
-  target's greedy decode. Losing branches' blocks never leave the pool
+  target's sequential decode (greedy or seeded-sampled — the verify
+  samples each window position at its own ``(seed, position)``
+  counter, so acceptance-against-greedy-drafts only changes speed,
+  never the stream). Losing branches' blocks never leave the pool
   accounting: block membership is fixed per request; the winner's
   verify window is copied across siblings inside the step.
+* ``sampling=`` on admission turns a row sampled: per-request
+  :class:`SamplingParams` (seed / temperature / top-k / top-p and the
+  grammar ``mask_fn`` hook) live as batched DEVICE arrays the one
+  compiled step reads — param churn never retraces (``trace_count``
+  stays 1). Every sampled token's threefry key is a pure function of
+  ``(seed, position)`` (:func:`tpusystem.train.generate.sampling_key`),
+  so the journal's emitted prefix is the ONLY replay state: a replayed,
+  rerouted, or hedged row reproduces the identical sample stream
+  bitwise on any engine. ``temperature == 0`` (the default) is the
+  plain greedy argmax, bitwise-unchanged.
 """
 
 from __future__ import annotations
@@ -70,12 +83,75 @@ from tpusystem.serve.kvcache import (PagedKVCache, _is_kv, adopt_prefill,
 from tpusystem.train.cursors import gather_rows, is_cursor, read_cursor, rewind
 from tpusystem.train.decode_fused import (build_fused_paged_step,
                                           fused_paged_reason)
-from tpusystem.train.generate import _decoder, _dequant, _stream_params
+from tpusystem.train.generate import (_decoder, _dequant, _stream_params,
+                                      sample_token)
 
 
 class Saturated(RuntimeError):
     """No free row or not enough free blocks — the request must stay
     queued (the scheduler's job), never crash the engine."""
+
+
+class UnseededSampling(ValueError):
+    """A ``temperature > 0`` request with no seed: its stream would be
+    non-reproducible, which vacates every replay/reroute/hedging
+    guarantee this stack makes — refused typed at the front door
+    (router, scheduler, AND engine) instead of silently degrading to a
+    divergent duplicate. Subclasses ``ValueError`` so existing
+    caller-error handling (trace closed ``'invalid'``, re-raise)
+    applies unchanged."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decode-sampling controls, journal-replayable.
+
+    Rides the request through scheduler, journal, handoff, and hedging:
+    a seeded request's token at stream position ``p`` is a pure
+    function of ``(seed, p)`` plus the emitted prefix, so replay needs
+    no RNG state beyond what the journal already records.
+
+    Attributes:
+        seed: threefry counter seed. Required when ``temperature > 0``
+            (an unseeded sampled request raises
+            :class:`UnseededSampling` at submit); ignored at
+            ``temperature == 0``.
+        temperature: 0 (default) is greedy argmax — bitwise the
+            engine's classic path; > 0 scales logits before sampling.
+        top_k: keep only the k highest logits (0 = no top-k filter).
+        top_p: nucleus filter — keep the smallest sorted prefix whose
+            cumulative mass reaches ``top_p`` (1.0 = no filter).
+        mask_fn: the structured-output hook — a picklable
+            **module-level** callable ``(emitted: list[int]) ->
+            bool[vocab]`` (journal replay re-imports it) evaluated
+            host-side before every sampled position; ``False`` tokens
+            are excluded before temperature/top-k/top-p. Must allow at
+            least one token (an all-False mask is a typed caller
+            error — give the grammar an escape hatch such as EOS).
+            Composes with greedy too (masked argmax). Does NOT compose
+            with speculative rows (the mask cannot update inside a
+            multi-token verify window — typed at admit).
+    """
+    seed: int | None = None
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    mask_fn: object = None
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(
+                f'temperature must be >= 0, got {self.temperature}')
+        if self.top_k < 0:
+            raise ValueError(f'top_k must be >= 0, got {self.top_k}')
+        if not 0 < self.top_p <= 1:
+            raise ValueError(
+                f'top_p must be in (0, 1], got {self.top_p}')
+
+    @property
+    def sampled(self) -> bool:
+        """True when this request actually samples (``temperature > 0``)."""
+        return self.temperature > 0
 
 
 def engine_unsupported_reason(module) -> str | None:
@@ -123,14 +199,17 @@ def _build_prefill(decoder, bucket: int):
     del bucket          # part of the cache key; shapes key the jit cache
 
     @jax.jit
-    def run(params, padded, length):
+    def run(params, padded, length, seed, position, temp, topk, topp, mask):
         # plain contiguous prefill: one causal pass over the padded
         # prompt builds every layer's [1, max_seq, ...] KV strip; the
         # right-pad junk is causally invisible to the real positions
         logits, state = decoder.apply(
             {'params': _dequant(params, decoder)}, padded,
             mutable=['cache'])
-        first = jnp.argmax(logits[0, length - 1], axis=-1).astype(jnp.int32)
+        # the first token samples at the row's own (seed, position)
+        # counter — greedy defaults reproduce the classic argmax bitwise
+        first = sample_token(logits[0, length - 1], seed, position, temp,
+                             topk, topp, mask)
         return first, state['cache']
 
     return run
@@ -160,12 +239,13 @@ def _build_resume(decoder, bucket: int):
         jnp.zeros((1, 1), jnp.int32))['cache']
 
     @jax.jit
-    def run(params, cache, slots, padded, cached_len, suffix_len):
+    def run(params, cache, slots, padded, cached_len, suffix_len,
+            seed, position, temp, topk, topp, mask):
         source = {jax.tree_util.keystr(path): leaf for path, leaf
                   in jax.tree_util.tree_leaves_with_path(cache)}
         keep = jnp.arange(decoder.max_seq) < cached_len
 
-        def seed(path, leaf):
+        def seed_leaf(path, leaf):
             if _is_kv(path):
                 pool = source[jax.tree_util.keystr(path)]
                 strip = jnp.take(pool, slots, axis=0)    # [max_seq, h, d]
@@ -175,12 +255,12 @@ def _build_resume(decoder, bucket: int):
                 return jnp.full(leaf.shape, cached_len, leaf.dtype)
             return jnp.zeros(leaf.shape, leaf.dtype)
 
-        resumed = jax.tree_util.tree_map_with_path(seed, shapes)
+        resumed = jax.tree_util.tree_map_with_path(seed_leaf, shapes)
         logits, state = decoder.apply(
             {'params': _dequant(params, decoder), 'cache': resumed},
             padded, mutable=['cache'])
-        first = jnp.argmax(logits[0, suffix_len - 1],
-                           axis=-1).astype(jnp.int32)
+        first = sample_token(logits[0, suffix_len - 1], seed, position,
+                             temp, topk, topp, mask)
         return first, state['cache']
 
     return run
@@ -268,6 +348,10 @@ class _RowState:
     max_new: int
     stop: int | None
     tag: object = None               # opaque caller handle (request id)
+    sampling: object = None          # SamplingParams | None (greedy)
+    prior: tuple = ()                # tokens emitted in a previous life
+    #                                  (replay prefix) — position and
+    #                                  mask_fn both see prior + tokens
 
 
 class Engine:
@@ -297,9 +381,12 @@ class Engine:
             successive) requests sharing a prompt prefix share KV blocks
             and prefill only their uncached suffix.
         draft_module / draft_params: a cheap draft LM switches the step
-            to speculative rows (module docstring). Greedy only;
-            ``decode_impl='fused'`` does not compose (the verify forward
-            is the flax paged step).
+            to speculative rows (module docstring) — the output stays
+            exactly the target's sequential decode, greedy and
+            seeded-sampled alike (``mask_fn`` does not compose;
+            a grammar mask cannot update inside a multi-token verify
+            window). ``decode_impl='fused'`` does not compose (the
+            verify forward is the flax paged step).
         speculate: draft tokens proposed per speculative step.
         tree_fanout: branch rows per request (token-tree verify);
             ``rows`` must be a multiple.
@@ -403,11 +490,27 @@ class Engine:
         self._active = np.zeros(rows, bool)
         self._tokens_dev = jnp.zeros(rows, jnp.int32)
         self._active_dev = jnp.zeros(rows, bool)
+        # per-row sampling params as batched device arrays: the one
+        # compiled step reads them, admission/eviction edit them with
+        # fixed-shape .at[] writes — param churn never retraces. Greedy
+        # defaults (temp 0, no filters, all-True mask) make an idle or
+        # unsampled row bitwise the classic argmax path.
+        self.vocab = module.vocab_size
+        self._seed_dev = jnp.zeros(rows, jnp.uint32)
+        self._pos_dev = jnp.zeros(rows, jnp.int32)
+        self._temp_dev = jnp.zeros(rows, jnp.float32)
+        self._topk_dev = jnp.zeros(rows, jnp.int32)
+        self._topp_dev = jnp.ones(rows, jnp.float32)
+        self._mask_dev = jnp.ones((rows, self.vocab), bool)
         if self.tp_plan.path == 'gspmd':
             from jax.sharding import NamedSharding, PartitionSpec
             everywhere = NamedSharding(self.mesh, PartitionSpec())
             self._tokens_dev = jax.device_put(self._tokens_dev, everywhere)
             self._active_dev = jax.device_put(self._active_dev, everywhere)
+            for name in ('_seed_dev', '_pos_dev', '_temp_dev', '_topk_dev',
+                         '_topp_dev', '_mask_dev'):
+                setattr(self, name,
+                        jax.device_put(getattr(self, name), everywhere))
         self._rowstate: dict[int, _RowState] = {}
         self._prefills: dict[object, object] = {}  # unhashable-module path
         self._resumes: dict[int, object] = {}
@@ -441,31 +544,42 @@ class Engine:
             self._step = None
             return
 
+        # every row samples at its own (seed, position) counter; greedy
+        # rows (temp 0) take the argmax branch of the same program
+        sample_rows = jax.vmap(sample_token)
+
         if self.decode_impl == 'fused':
             fused = build_fused_paged_step(self._decoder)
 
-            def step_fn(params, cache, tokens, active):
+            def step_fn(params, cache, tokens, active, seed, pos, temp,
+                        topk, topp, mask):
                 self.trace_count += 1        # runs at trace time only
                 logits, updated = fused(params, cache, tokens)
-                token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                token = sample_rows(logits, seed, pos, temp, topk, topp,
+                                    mask)
                 cursor = read_cursor(cache)
-                return token, rewind(updated,
-                                     jnp.where(active, cursor + 1, 0))
+                return (token,
+                        rewind(updated, jnp.where(active, cursor + 1, 0)),
+                        jnp.where(active, pos + 1, pos))
         else:
-            def step_fn(params, cache, tokens, active):
+            def step_fn(params, cache, tokens, active, seed, pos, temp,
+                        topk, topp, mask):
                 self.trace_count += 1        # runs at trace time only
                 logits, updated = self._decoder.apply(
                     {'params': _dequant(params, self._decoder),
                      'cache': cache},
                     tokens[:, None], mutable=['cache'])
-                token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                token = sample_rows(logits[:, -1], seed, pos, temp, topk,
+                                    topp, mask)
                 # park retired rows' cursors at 0 so their dead writes
                 # stay in the trash block's first slots instead of
                 # walking off the table; active rows keep the cursor
                 # cached_attention advanced
                 cursor = read_cursor(cache)
-                return token, rewind(updated['cache'],
-                                     jnp.where(active, cursor + 1, 0))
+                return (token,
+                        rewind(updated['cache'],
+                               jnp.where(active, cursor + 1, 0)),
+                        jnp.where(active, pos + 1, pos))
 
         self._step = jax.jit(step_fn, donate_argnums=(1,))
 
@@ -525,7 +639,8 @@ class Engine:
         max_blocks = self.max_seq // block
         branch = jnp.arange(rows) % F
 
-        def spec_step(params, dparams, cache, dcache, tokens, active):
+        def spec_step(params, dparams, cache, dcache, tokens, active,
+                      seed, pos, temp, topk, topp, mask):
             self.trace_count += 1            # runs at trace time only
             cursor0 = read_cursor(cache)
 
@@ -557,7 +672,23 @@ class Engine:
             vlogits, tupdated = decoder.apply(
                 {'params': _dequant(params, decoder), 'cache': cache},
                 window, mutable=['cache'])
-            candidates = jnp.argmax(vlogits, axis=-1).astype(jnp.int32)
+
+            # verify SAMPLES each window slot j at its own counter
+            # (seed, pos + j): the accepted prefix + correction is then
+            # exactly the sequential sampled stream — a greedy draft
+            # token is accepted iff it equals the sampled target choice,
+            # so mismatched drafts cost speed, never the stream. Greedy
+            # rows (temp 0) reduce to the classic argmax verify bitwise.
+            def sample_window(logits_w, seed_r, pos_r, temp_r, topk_r,
+                              topp_r, mask_r):
+                offsets = pos_r + jnp.arange(K + 1)
+                return jax.vmap(
+                    lambda logits_j, pos_j: sample_token(
+                        logits_j, seed_r, pos_j, temp_r, topk_r, topp_r,
+                        mask_r))(logits_w, offsets)
+
+            candidates = jax.vmap(sample_window)(vlogits, seed, pos, temp,
+                                                 topk, topp, mask)
             matches = (drafts == candidates[:, :K]).astype(jnp.int32)
             accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)
 
@@ -596,7 +727,8 @@ class Engine:
             dcache = rewind(gather_rows(dcache, rowmap), new_cursor)
             wide_next = jnp.repeat(next_token, F)
             new_tokens = jnp.where(active, wide_next, tokens)
-            return emitted, accepted_w, new_tokens, tcache, dcache
+            new_pos = jnp.where(active, pos + jnp.repeat(advance, F), pos)
+            return emitted, accepted_w, new_tokens, tcache, dcache, new_pos
 
         return spec_step
 
@@ -609,6 +741,13 @@ class Engine:
     @property
     def active_rows(self) -> int:
         return int(self._active.sum())
+
+    @property
+    def sampled_rows(self) -> int:
+        """Seated requests currently decoding with ``temperature > 0``
+        (the observability plane's sampled-traffic gauge)."""
+        return sum(1 for state in self._rowstate.values()
+                   if state.sampling is not None and state.sampling.sampled)
 
     def can_admit(self, prompt_len: int, max_new: int,
                   prompt=None) -> bool:
@@ -656,17 +795,60 @@ class Engine:
         suffix = max(len(prompt) - self.prefix_cached_len(prompt), 1)
         return self.bucket(suffix)
 
-    def _run_prefill(self, decoder, bucket: int, padded, length: int):
+    def _greedy_ops(self, vocab: int):
+        """The greedy-default sampling operands: what an unsampled (or
+        draft) prefill passes so its first-token choice is bitwise the
+        classic argmax."""
+        return (jnp.uint32(0), jnp.int32(0), jnp.float32(0.0),
+                jnp.int32(0), jnp.float32(1.0), jnp.ones(vocab, bool))
+
+    def _grammar_mask(self, sampling, stream: list):
+        """Evaluate ``mask_fn`` over the emitted stream so far and
+        validate its contract (bool ``[vocab]``, at least one token
+        allowed) — all-True when the request has no mask."""
+        if sampling is None or sampling.mask_fn is None:
+            return jnp.ones(self.vocab, bool)
+        mask = np.asarray(sampling.mask_fn(list(stream)), bool).reshape(-1)
+        if mask.shape[0] != self.vocab:
+            raise ValueError(
+                f'mask_fn returned {mask.shape[0]} entries, the vocab is '
+                f'{self.vocab}')
+        if not mask.any():
+            raise ValueError(
+                'mask_fn allowed no token after '
+                f'{len(stream)} emitted — a grammar must always leave an '
+                'escape hatch (e.g. its stop token)')
+        return jnp.asarray(mask)
+
+    def _sampling_ops(self, sampling, emitted):
+        """jnp-typed per-request sampling operands for the FIRST token —
+        position ``len(emitted)`` (the stream slots already journaled in
+        a previous life), scalars typed so jitted programs never retrace
+        on Python weak types."""
+        position = len(emitted)
+        if sampling is None:
+            return (jnp.uint32(0), jnp.int32(position), jnp.float32(0.0),
+                    jnp.int32(0), jnp.float32(1.0),
+                    jnp.ones(self.vocab, bool))
+        return (jnp.uint32(sampling.seed or 0), jnp.int32(position),
+                jnp.float32(sampling.temperature),
+                jnp.int32(sampling.top_k), jnp.float32(sampling.top_p),
+                self._grammar_mask(sampling, list(emitted)))
+
+    def _run_prefill(self, decoder, bucket: int, padded, length: int,
+                     ops=None):
         try:
             run = _compiled_prefill(decoder, bucket)
         except TypeError:        # unhashable module field (e.g. live mesh)
             run = self._prefills.setdefault(
                 (decoder is self._prefiller, bucket),
                 _build_prefill(decoder, bucket))
+        if ops is None:
+            ops = self._greedy_ops(decoder.vocab_size)
         return run(self._params if decoder is self._prefiller
-                   else self._dparams, jnp.asarray(padded), length)
+                   else self._dparams, jnp.asarray(padded), length, *ops)
 
-    def _prefill_rows(self, prompt, rows: list[int]):
+    def _prefill_rows(self, prompt, rows: list[int], ops):
         """Target prefill for an admission already seated in the pool:
         the resume program over the uncached suffix when the first row
         adopted a shareable prefix (and the suffix window fits), the
@@ -687,16 +869,16 @@ class Engine:
             first, prefill_cache = run(
                 self._params, self._cache,
                 jnp.asarray(self.pool.slots(rows[0])),
-                jnp.asarray(padded), shared, suffix)
+                jnp.asarray(padded), shared, suffix, *ops)
             self.sharing['resumed_prefills'] += 1
             return first, prefill_cache
         bucket = self.bucket(prompt.size)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :prompt.size] = prompt
         return self._run_prefill(self._prefiller, bucket, padded,
-                                 prompt.size)
+                                 prompt.size, ops)
 
-    def _validate(self, prompt, max_new: int) -> None:
+    def _validate(self, prompt, max_new: int, sampling=None) -> None:
         if prompt.size < 1:
             raise ValueError('empty prompt')
         if max_new < 1:
@@ -705,6 +887,7 @@ class Engine:
             raise ValueError(
                 f'prompt ({prompt.size}) + max_new ({max_new}) exceeds the '
                 f'cache capacity max_seq={self.max_seq}')
+        self._validate_sampling(sampling)
         if self._spec:
             needed = prompt.size + max_new + self.speculate + 1
             if needed > self._drafter.max_seq:
@@ -713,6 +896,21 @@ class Engine:
                     f'the draft cache capacity max_seq='
                     f'{self._drafter.max_seq} (the draft overshoots by up '
                     'to speculate tokens before rewinding)')
+
+    def _validate_sampling(self, sampling) -> None:
+        if sampling is None:
+            return
+        if sampling.sampled and sampling.seed is None:
+            raise UnseededSampling(
+                f'temperature {sampling.temperature} with no seed: the '
+                'stream would not be reproducible, so journal replay, '
+                'reroute, and hedging could not keep their token-exact '
+                'contract — pass SamplingParams(seed=...)')
+        if self._spec and sampling.mask_fn is not None:
+            raise ValueError(
+                'mask_fn does not compose with speculative rows — a '
+                'grammar mask cannot update inside a multi-token verify '
+                'window; serve structured requests on the plain engine')
 
     def _seat(self, prompt, max_new: int) -> tuple[int, list[int]]:
         """Claim a free row group and seat it in the pool (rolled back
@@ -746,9 +944,11 @@ class Engine:
         return rep, rows
 
     def _register(self, rep: int, rows: list[int], prompt, first: int,
-                  max_new: int, stop_token: int | None, tag) -> Admission:
+                  max_new: int, stop_token: int | None, tag,
+                  sampling=None, emitted=()) -> Admission:
         """The host-side admission tail: sharing counters, row state,
-        token/active mirrors, and the admitted-already-finished check."""
+        sampling device arrays, token/active mirrors, and the
+        admitted-already-finished check."""
         fanout = self.tree_fanout if self._spec else 1
         self.sharing['admissions'] += 1
         self.sharing['prompt_tokens'] += int(prompt.size) * fanout
@@ -756,32 +956,58 @@ class Engine:
         self.sharing['shared_tokens'] += shared_total
         self.sharing['prefix_hits'] += bool(shared_total)
 
+        seed = 0 if sampling is None or sampling.seed is None \
+            else sampling.seed
+        temp = 0.0 if sampling is None else sampling.temperature
+        topk = 0 if sampling is None else sampling.top_k
+        topp = 1.0 if sampling is None else sampling.top_p
+        # the NEXT token's stream position: `first` just landed at
+        # position len(emitted), so the step samples at len(emitted) + 1
+        start = len(emitted) + 1
         for row in rows:
             self._tokens[row] = first
             self._active[row] = True
             self._tokens_dev = self._tokens_dev.at[row].set(first)
             self._active_dev = self._active_dev.at[row].set(True)
+            self._seed_dev = self._seed_dev.at[row].set(np.uint32(seed))
+            self._pos_dev = self._pos_dev.at[row].set(start)
+            self._temp_dev = self._temp_dev.at[row].set(temp)
+            self._topk_dev = self._topk_dev.at[row].set(topk)
+            self._topp_dev = self._topp_dev.at[row].set(topp)
         self._rowstate[rep] = _RowState(tokens=[first], max_new=max_new,
-                                        stop=stop_token, tag=tag)
+                                        stop=stop_token, tag=tag,
+                                        sampling=sampling,
+                                        prior=tuple(emitted))
         reason = self._finish_reason(rep)
         if reason is not None:
             self.evict(rep)
             return Admission(rep, first, True, reason)
+        if sampling is not None and sampling.mask_fn is not None:
+            mask = self._grammar_mask(sampling, list(emitted) + [first])
+            for row in rows:
+                self._mask_dev = self._mask_dev.at[row].set(mask)
         return Admission(rep, first, False)
 
     def admit(self, prompt, max_new: int, *, stop_token: int | None = None,
-              tag=None) -> Admission:
+              tag=None, sampling=None, emitted=()) -> Admission:
         """Prefill ``prompt`` and seat it in a free row (a free GROUP of
         ``tree_fanout`` adjacent rows when speculative). Raises
         :class:`Saturated` when no row or not enough blocks are free
         (the scheduler queues on this), ``ValueError`` on requests that
-        could never fit."""
+        could never fit — :class:`UnseededSampling` among them.
+
+        ``sampling`` is the request's :class:`SamplingParams` (None =
+        greedy); ``emitted`` the tokens a previous life already emitted
+        for this request (journal replay passes its prefix here so
+        sampling positions continue where the stream left off — the
+        prompt must already include those tokens)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        self._validate(prompt, max_new)
+        self._validate(prompt, max_new, sampling)
+        ops = self._sampling_ops(sampling, emitted)
         rep, rows = self._seat(prompt, max_new)
 
         started = time.perf_counter()
-        first, prefill_cache = self._prefill_rows(prompt, rows)
+        first, prefill_cache = self._prefill_rows(prompt, rows, ops)
         first = int(first)
         self.timings['prefill'] += time.perf_counter() - started
 
@@ -805,18 +1031,22 @@ class Engine:
                                              prompt.size)
         self.timings['admit'] += time.perf_counter() - started
         return self._register(rep, rows, prompt, first, max_new,
-                              stop_token, tag)
+                              stop_token, tag, sampling, emitted)
 
     # ------------------------------------------------- disaggregated prefill
 
-    def export_prefill(self, prompt) -> tuple[int, dict]:
+    def export_prefill(self, prompt, *, sampling=None,
+                       emitted=()) -> tuple[int, dict]:
         """Run the admission prefill WITHOUT seating a row — the
         prefill-role half of disaggregated serving. Returns ``(first,
         kv)``: the prompt's first token and every layer's contiguous KV
         strip (``keystr path -> [1, max_seq, heads, head_dim]`` numpy,
         host-side so the blob plane can ship it). The decode-role
         replica seats it with :meth:`admit_prefilled`; this engine's
-        pool, rows and sharing index are untouched."""
+        pool, rows and sharing index are untouched. A sampled request's
+        first token samples at its ``(seed, len(emitted))`` counter —
+        a pure function, so the prefill replica's choice is exactly
+        what the decode replica would have computed itself."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError('empty prompt')
@@ -824,12 +1054,14 @@ class Engine:
             raise ValueError(
                 f'prompt ({prompt.size}) leaves no decode room under '
                 f'max_seq={self.max_seq}')
+        self._validate_sampling(sampling)
+        ops = self._sampling_ops(sampling, emitted)
         bucket = self.bucket(prompt.size)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :prompt.size] = prompt
         started = time.perf_counter()
         first, prefill_cache = self._run_prefill(self._prefiller, bucket,
-                                                 padded, prompt.size)
+                                                 padded, prompt.size, ops)
         first = int(first)
         self.timings['prefill'] += time.perf_counter() - started
         kv = {jax.tree_util.keystr(path): np.asarray(leaf)
@@ -865,8 +1097,8 @@ class Engine:
         return jax.tree_util.tree_map_with_path(fill, shapes)
 
     def admit_prefilled(self, prompt, max_new: int, first: int, kv: dict,
-                        *, stop_token: int | None = None,
-                        tag=None) -> Admission:
+                        *, stop_token: int | None = None, tag=None,
+                        sampling=None, emitted=()) -> Admission:
         """Seat a request whose prefill ran on ANOTHER engine
         (:meth:`export_prefill` strips, shipped over the blob plane).
         Same contract as :meth:`admit` — Saturated when nothing is free,
@@ -880,7 +1112,7 @@ class Engine:
                 'the draft cache has no handoff strip; disaggregate the '
                 'plain engine')
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        self._validate(prompt, max_new)
+        self._validate(prompt, max_new, sampling)
         prefill_cache = self._strip_cache(kv)     # validate BEFORE seating
         rep, rows = self._seat(prompt, max_new)
 
@@ -893,7 +1125,7 @@ class Engine:
         self._cache = write_tables(self._cache, self.pool.table)
         self.timings['admit'] += time.perf_counter() - started
         return self._register(rep, rows, prompt, int(first), max_new,
-                              stop_token, tag)
+                              stop_token, tag, sampling, emitted)
 
     def prefix_hit_rate(self) -> float:
         """Fraction of admitted prompt tokens served from the radix
@@ -913,17 +1145,19 @@ class Engine:
 
     def step(self) -> StepReport:
         """Advance every active row (one fixed-shape dispatch): one
-        greedy token per request on the plain step, up to ``speculate +
-        1`` on the speculative step. Retires rows that hit their length
-        or stop token."""
+        token per request on the plain step (greedy or sampled, per the
+        row's :class:`SamplingParams`), up to ``speculate + 1`` on the
+        speculative step. Retires rows that hit their length or stop
+        token."""
         if not self._active.any():
             return StepReport({}, [])
         if self._spec:
             return self._spec_tick()
         started = time.perf_counter()
-        token_dev, self._cache = self._step(self._params, self._cache,
-                                            self._tokens_dev,
-                                            self._active_dev)
+        token_dev, self._cache, self._pos_dev = self._step(
+            self._params, self._cache, self._tokens_dev, self._active_dev,
+            self._seed_dev, self._pos_dev, self._temp_dev, self._topk_dev,
+            self._topp_dev, self._mask_dev)
         token = np.asarray(token_dev)
         # retired rows' stale device token stays as-is (in-vocab junk an
         # inactive row may keep embedding — masked, never emitted)
@@ -935,19 +1169,30 @@ class Engine:
             row = int(row)
             self._tokens[row] = int(token[row])
             emitted[row] = [int(token[row])]
-            self._rowstate[row].tokens.append(int(token[row]))
+            state = self._rowstate[row]
+            state.tokens.append(int(token[row]))
             reason = self._finish_reason(row)
             if reason is not None:
                 state = self.evict(row)
                 finished.append((row, reason, list(state.tokens)))
+            elif (state.sampling is not None
+                  and state.sampling.mask_fn is not None):
+                # the grammar hook: re-evaluate the mask over the full
+                # stream so the NEXT position sees it — a host-side
+                # fixed-shape row write, never a retrace
+                mask = self._grammar_mask(
+                    state.sampling, list(state.prior) + list(state.tokens))
+                self._mask_dev = self._mask_dev.at[row].set(mask)
         return StepReport(emitted, finished)
 
     def _spec_tick(self) -> StepReport:
         started = time.perf_counter()
         emitted_dev, accepted_dev, self._tokens_dev, self._cache, \
-            self._dcache = self._spec_step(
+            self._dcache, self._pos_dev = self._spec_step(
                 self._params, self._dparams, self._cache, self._dcache,
-                self._tokens_dev, self._active_dev)
+                self._tokens_dev, self._active_dev, self._seed_dev,
+                self._pos_dev, self._temp_dev, self._topk_dev,
+                self._topp_dev, self._mask_dev)
         window = np.asarray(emitted_dev)             # [groups, K+1]
         accepted = np.asarray(accepted_dev)
         self.last_step_seconds = time.perf_counter() - started
@@ -986,11 +1231,18 @@ class Engine:
         if row not in self._rowstate:
             raise ValueError(f'row {row} is not seated')
         fanout = self.tree_fanout if self._spec else 1
+        state = self._rowstate[row]
         for member in range(row, row + fanout):
             self.pool.evict(member)
             self._active[member] = False
             self._tokens[member] = 0
             self._active_dev = self._active_dev.at[member].set(False)
+            # temp 0 + all-True mask return the row to the greedy
+            # default; stale seed/pos/topk/topp are inert under temp 0
+            if state.sampling is not None:
+                self._temp_dev = self._temp_dev.at[member].set(0.0)
+                if state.sampling.mask_fn is not None:
+                    self._mask_dev = self._mask_dev.at[member].set(True)
         self._cache = write_tables(self._cache, self.pool.table)
         self._free_rows.append(row)
         return self._rowstate.pop(row)

@@ -23,7 +23,9 @@ survival layer, built on the machinery that already exists:
 * **Replay** (:func:`replay`) — a relaunched engine rebuilds its batch
   by re-queueing each journaled request with its emitted prefix; the
   scheduler re-prefills ``prompt + prefix`` and resumes decode. Greedy
-  decode is deterministic, so the final completion (prefix + resumed
+  and seeded sampled decode are both deterministic (counter-based
+  sampling: token at stream position ``p`` is a pure function of the
+  request's seed and ``p``), so the final completion (prefix + resumed
   tokens) is **token-exact** against an uninterrupted reference — the
   headline drill of ``tests/test_serve_failover.py`` and the SIGKILL
   stage of ``__graft_entry__.dryrun_multichip``. A row the journal only
@@ -52,10 +54,12 @@ narrated on the bus (``RequestReplayed`` / ``EngineRestarted`` /
 ``LoadShed``) and charted by the TensorBoard consumer
 (``serve/recovery_seconds|replayed|shed``).
 
-Determinism caveat: replay is token-exact for **greedy** decode only.
-Sampled decode would need each row's RNG state journaled alongside its
-tokens; the engine is greedy-only today, and docs/serving.md records the
-caveat for when sampling lands.
+Determinism: replay is token-exact for greedy AND seeded sampled
+decode. The sampling counter is a pure function of ``(seed, position)``
+(:func:`tpusystem.train.generate.sampling_key`), so no RNG state needs
+journaling beyond what the journal already holds — the emitted prefix IS
+the position. A pre-sampling packed journal (no ``sampling`` field on
+its requests) unpacks as greedy (:meth:`RequestJournal.unpack`).
 """
 
 from __future__ import annotations
@@ -146,9 +150,9 @@ class RequestJournal:
     :meth:`observe_tick` once per scheduler step — which packs and pushes
     the journal to ``client`` every ``cadence`` ticks. ``cadence`` is the
     durability window: a kill can lose at most the last ``cadence - 1``
-    ticks of token deltas, and replay simply re-decodes them (greedy is
-    deterministic, so the outcome is unchanged — only the recovery does
-    more work).
+    ticks of token deltas, and replay simply re-decodes them (greedy
+    and seeded sampled decode are deterministic, so the outcome is
+    unchanged — only the recovery does more work).
 
     ``client`` is anything with the memstore read/write surface: a
     :class:`~tpusystem.checkpoint.memstore.MemStoreClient` (the
@@ -216,7 +220,11 @@ class RequestJournal:
     def unpack(data: bytes) -> tuple[int, list]:
         """``(tick, [(request, waited, emitted), ...])`` from
         :meth:`pack` bytes; raises :exc:`JournalCorrupt` when the digest
-        or shape does not verify."""
+        or shape does not verify. A journal packed before sampling
+        existed carries requests with no ``sampling`` attribute in their
+        pickled ``__dict__`` — those normalize to ``sampling = None``
+        (greedy), so an upgrade mid-incident replays an old journal
+        token-exactly instead of crashing on the missing field."""
         digest, sep, payload = bytes(data).partition(b':')
         if not sep or _blob_digest(payload).encode('ascii') != digest:
             raise JournalCorrupt(
@@ -226,6 +234,11 @@ class RequestJournal:
             tick, rows = pickle.loads(payload)
             rows = [(request, float(waited), list(emitted))
                     for request, waited, emitted in rows]
+            for request, _, _ in rows:
+                # instance __dict__, not hasattr: the dataclass default
+                # is a class attribute, so hasattr is always True
+                if 'sampling' not in vars(request):
+                    request.sampling = None       # pre-sampling journal
         except Exception as error:
             raise JournalCorrupt(
                 f'journal payload does not decode ({error}); treating as '
@@ -315,7 +328,7 @@ class ReplayReport:
     """What a relaunch recovered: ``replayed`` rows re-prefill
     ``prompt + emitted`` and resume mid-stream ('hot'); ``resubmitted``
     rows were only ever queued and re-enter cold. Either way the final
-    completion is token-exact under greedy decode."""
+    completion is token-exact — greedy and seeded sampled alike."""
 
     replayed: list = dataclasses.field(default_factory=list)
     resubmitted: list = dataclasses.field(default_factory=list)

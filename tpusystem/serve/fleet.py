@@ -28,8 +28,10 @@ robustness moves:
   **redistributes** the rows across the surviving replicas:
   seated rows re-prefill ``prompt + emitted prefix`` on a *different
   engine* and resume decode (hot handoff), queued-only rows re-submit
-  cold. Greedy decode is deterministic, so the final completions are
-  token-exact against an uninterrupted fleet — drilled by
+  cold. Greedy and seeded sampled decode are both deterministic (the
+  sampling counter is a pure function of ``(seed, position)``), so the
+  final completions are token-exact against an uninterrupted fleet —
+  drilled by
   ``tests/test_serve_fleet.py`` with a
   :class:`~tpusystem.parallel.chaos.PreemptionWave` killing replicas
   mid-stream. Rows routed after the journal's last push (the cadence
@@ -43,9 +45,13 @@ robustness moves:
   — first completion wins, the loser is cancelled. Both reroute paths
   thread the ORIGINAL submission time through
   :meth:`~tpusystem.serve.Scheduler.restore`'s ``waited=``, so TTFT and
-  latency accounting never reset on a retry. Hedging is safe because
-  decode is greedy; sampled decode would race two different answers
-  (docs/serving.md records the caveat).
+  latency accounting never reset on a retry. Hedging is safe for greedy
+  AND seeded sampled decode alike: with counter-based sampling both legs
+  of a hedge emit the identical stream (token at position ``p`` is a
+  pure function of ``(seed, p)``), so first-completion-wins can never
+  race two different answers. The one thing that would break this — an
+  *unseeded* sampled request — is refused typed
+  (:exc:`~tpusystem.serve.UnseededSampling`) at the front door.
 * **Fleet degradation + autoscale** — fleet-scope
   :class:`~tpusystem.serve.Watermarks` shed by deadline slack across
   the WHOLE fleet's queues (the globally most-doomed request goes
@@ -76,7 +82,7 @@ from tpusystem.serve.disagg import (HandoffCorrupt, kv_namespace,
                                     pack_handoff, unpack_handoff)
 from tpusystem.serve.failover import Watermarks, recover_journal
 from tpusystem.serve.scheduler import QueueFull
-from tpusystem.serve.engine import Saturated
+from tpusystem.serve.engine import Saturated, UnseededSampling
 
 logger = logging.getLogger('tpusystem.serve.fleet')
 
@@ -527,7 +533,19 @@ class Router:
         the fleet is empty/dead and :exc:`FleetSaturated` when every
         healthy backlog is full — or when the fleet is in brownout and
         the request carries no deadline (degrade at the front door
-        before the backlog collapses)."""
+        before the backlog collapses). An *unseeded* sampled request is
+        refused typed (:exc:`~tpusystem.serve.UnseededSampling`) before
+        placement: every fleet robustness move — replay, reroute,
+        hedging — relies on decode being reproducible, and unseeded
+        sampling is the one configuration that is not."""
+        sampling = getattr(request, 'sampling', None)
+        if (sampling is not None and sampling.sampled
+                and sampling.seed is None):
+            raise UnseededSampling(
+                f'request {request.id!r} refused: sampled decode '
+                f'(temperature > 0) without a seed is not reproducible — '
+                f'replay, reroute, and hedging all require a seeded '
+                f'stream; set SamplingParams.seed')
         if self.brownout and getattr(request, 'deadline', None) is None:
             raise FleetSaturated(
                 f'request {request.id!r} refused: the fleet is past its '

@@ -37,7 +37,7 @@ from typing import Callable
 
 import jax
 
-from tpusystem.serve.engine import Engine
+from tpusystem.serve.engine import Engine, SamplingParams  # noqa: F401
 from tpusystem.serve.failover import RequestJournal, Watermarks  # noqa: F401
 
 
@@ -69,19 +69,26 @@ class QueueFull(RuntimeError):
 class Request:
     """One user request: a prompt and a generation budget.
 
-    Greedy decoding only (temperature sampling needs per-row rng
-    plumbing the engine does not carry yet); ``stop_token`` ends the
-    request early, with the stop token included in the output.
-    ``deadline`` (seconds from submission, None = forever) bounds the
-    request's whole life: a queued request that cannot be seated before
-    it — the starvation case under saturation — or an active one still
-    decoding past it expires with a typed ``RequestExpired`` event and
-    reason ``'expired'`` instead of waiting silently forever."""
+    ``sampling`` (a :class:`~tpusystem.serve.engine.SamplingParams`,
+    None = greedy) selects seeded temperature/top-k/top-p sampling and
+    the grammar ``mask_fn`` hook — deterministic by construction (each
+    token's RNG key is a pure function of ``(seed, position)``), so
+    journal replay, reroute, and hedging stay token-exact for sampled
+    requests too; a ``temperature > 0`` request without a seed is
+    refused typed (:class:`~tpusystem.serve.engine.UnseededSampling`)
+    at submit. ``stop_token`` ends the request early, with the stop
+    token included in the output. ``deadline`` (seconds from
+    submission, None = forever) bounds the request's whole life: a
+    queued request that cannot be seated before it — the starvation
+    case under saturation — or an active one still decoding past it
+    expires with a typed ``RequestExpired`` event and reason
+    ``'expired'`` instead of waiting silently forever."""
     id: str
     prompt: object                   # int sequence
     max_new: int
     stop_token: int | None = None
     deadline: float | None = None
+    sampling: SamplingParams | None = None
     trace: object = None
     # the request's causal identity (tpusystem.observe.TraceContext),
     # assigned by the first traced component that sees it (router or
@@ -96,7 +103,9 @@ class _Pending:
     submitted: float
     # tokens already emitted before an engine relaunch (the journal
     # replay path): the engine re-prefills prompt + prefix and the final
-    # Completion is prefix + resumed tokens — token-exact under greedy
+    # Completion is prefix + resumed tokens — token-exact for greedy AND
+    # seeded sampled decode (the prefix length restarts the sampling
+    # position counter exactly where the stream left off)
     prefix: list = dataclasses.field(default_factory=list)
     # a KVHandoff when the prefill already ran on ANOTHER replica
     # (disaggregated ingest): admission adopts the shipped strips via
@@ -218,6 +227,10 @@ class Scheduler:
             raise ValueError(
                 f'request {request.id!r}: deadline must be positive seconds '
                 f'from submission, got {request.deadline!r}')
+        # refuse non-reproducible sampling at the door (UnseededSampling,
+        # a ValueError): once queued, every downstream guarantee —
+        # journal replay, reroute, hedging — would silently break
+        self.engine._validate_sampling(getattr(request, 'sampling', None))
         if prompt_len + request.max_new > self.engine.max_seq:
             raise ValueError(
                 f'request {request.id!r}: prompt ({prompt_len}) + max_new '
@@ -249,8 +262,10 @@ class Scheduler:
         :func:`tpusystem.serve.failover.replay` entry): ``prefix`` is the
         tokens already emitted before the failure — admission re-prefills
         ``prompt + prefix`` and decodes the remaining budget, and the
-        final Completion is ``prefix + resumed tokens`` (token-exact
-        under greedy decode). ``waited`` backdates the submission so
+        final Completion is ``prefix + resumed tokens`` (token-exact for
+        greedy and seeded sampled decode alike — the sampling counter is
+        a pure function of position, and the prefix IS the position).
+        ``waited`` backdates the submission so
         deadline and latency accounting stay truthful across the
         relaunch (outage time between the last journal push and the
         relaunch is not counted — the journal packs waited-seconds)."""
@@ -510,7 +525,9 @@ class Scheduler:
                 break                    # budget spent this step
             if self.prefill_only:
                 self._queue.popleft()
-                first, kv = self.engine.export_prefill(prompt)
+                first, kv = self.engine.export_prefill(
+                    prompt, sampling=getattr(request, 'sampling', None),
+                    emitted=pending.prefix)
                 budget -= cost
                 from tpusystem.serve.disagg import KVHandoff
                 self.outbox.append(KVHandoff(
@@ -524,15 +541,18 @@ class Scheduler:
                                          prompt=prompt):
                 break                    # FIFO: wait for rows/blocks
             self._queue.popleft()
+            sampling = getattr(request, 'sampling', None)
             if pending.handoff is not None:
                 handoff, pending.handoff = pending.handoff, None
                 admission = self.engine.admit_prefilled(
                     prompt, remaining, handoff.first, handoff.kv,
-                    stop_token=request.stop_token, tag=request.id)
+                    stop_token=request.stop_token, tag=request.id,
+                    sampling=sampling, emitted=pending.prefix)
             else:
                 admission = self.engine.admit(
                     prompt, remaining,
-                    stop_token=request.stop_token, tag=request.id)
+                    stop_token=request.stop_token, tag=request.id,
+                    sampling=sampling, emitted=pending.prefix)
             budget -= cost
             ttft = self._clock() - pending.submitted
             admitted.append((request, admission, ttft))

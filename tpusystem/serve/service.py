@@ -6,7 +6,9 @@ name, so a CLI, REST surface, or the multihost control plane can drive
 the engine without importing it), and the request lifecycle fans out as
 domain events on a :class:`tpusystem.services.Producer`:
 ``RequestAdmitted`` / ``RequestEvicted`` / ``RequestCompleted`` /
-``ServeStepped`` (:mod:`tpusystem.observe.events`). The TensorBoard
+``ServeStepped`` / ``TokenStreamed`` (:mod:`tpusystem.observe.events`).
+Streaming requests (``submit(..., on_token=)``) additionally get every
+token delivered incrementally the step it materializes. The TensorBoard
 consumer charts queue depth, time-to-first-token, and tokens/sec off
 those events with zero engine code — the observability discipline every
 other subsystem in this framework follows.
@@ -22,7 +24,7 @@ import time
 from tpusystem.observe.events import (Backpressure, LoadShed,
                                       RequestAdmitted, RequestCompleted,
                                       RequestEvicted, RequestExpired,
-                                      ServeStepped)
+                                      ServeStepped, TokenStreamed)
 from tpusystem.serve.engine import Engine
 from tpusystem.serve.scheduler import Request, Scheduler, serve_levers
 from tpusystem.services.prodcon import Producer
@@ -57,6 +59,8 @@ class InferenceService:
         self._emitted = 0            # clock as the scheduler's deadlines
         self._started = None         # first-step wall clock, for tok/s
         self._backpressure = False   # last narrated watermark state
+        self._streams: dict = {}     # request id -> on_token callback
+        self._stream_index: dict = {}  # request id -> next stream index
         self.service = Service('serve')
         self.service.handler(self._named('submit', self.submit))
         self.service.handler(self._named('cancel', self.cancel))
@@ -72,20 +76,54 @@ class InferenceService:
 
     # -------------------------------------------------------------- intake
 
-    def submit(self, request: Request) -> None:
-        """Queue a request (command name ``'submit'``)."""
+    def submit(self, request: Request, on_token=None) -> None:
+        """Queue a request (command name ``'submit'``).
+
+        ``on_token`` turns the request streaming: called as
+        ``on_token(index, token)`` the step each token materializes —
+        index 0 is the first token (delivered at admission, so its
+        latency IS the TTFT the admission event charts), later indices
+        arrive one per decode step (a burst per step under speculative
+        rows). A cancel, deadline expiry, or completion ends the stream;
+        tokens already delivered stay delivered (a mid-stream ``expired``
+        verdict is truthful about the partial output). Each token is
+        also narrated as :class:`~tpusystem.observe.events.TokenStreamed`
+        for streaming requests."""
         self.scheduler.submit(request)
+        if on_token is not None:
+            self._streams[request.id] = on_token
+            self._stream_index[request.id] = 0
 
     def cancel(self, request_id: str) -> str | None:
         """Cancel a request (command name ``'cancel'``); an active one is
-        evicted mid-decode and narrated as ``RequestEvicted``."""
+        evicted mid-decode and narrated as ``RequestEvicted``. A
+        streaming request's ``on_token`` just stops being called —
+        tokens delivered before the cancel landed stay delivered."""
         where = self.scheduler.cancel(request_id)
+        self._close_stream(request_id)
         if where == 'active':
             completion = self.scheduler.results[request_id]
             self.producer.dispatch(RequestEvicted(
                 id=request_id, produced=len(completion.tokens),
                 reason='cancelled'))
         return where
+
+    # ------------------------------------------------------------ streaming
+
+    def _deliver(self, request_id: str, tokens) -> None:
+        stream = self._streams.get(request_id)
+        if stream is None:
+            return
+        for token in tokens:
+            index = self._stream_index[request_id]
+            self._stream_index[request_id] = index + 1
+            stream(index, int(token))
+            self.producer.dispatch(TokenStreamed(
+                id=request_id, index=index, token=int(token)))
+
+    def _close_stream(self, request_id: str) -> None:
+        self._streams.pop(request_id, None)
+        self._stream_index.pop(request_id, None)
 
     # ------------------------------------------------------------- serving
 
@@ -98,6 +136,7 @@ class InferenceService:
         # (tick.shed_depth, pre-shed) — the final queue_depth is
         # post-admission and would under-report the overload
         for completion, slack in tick.shed:
+            self._close_stream(completion.request.id)
             self.producer.dispatch(LoadShed(
                 id=completion.request.id,
                 produced=len(completion.tokens),
@@ -119,7 +158,15 @@ class InferenceService:
                 id=request.id, row=admission.row,
                 prompt_tokens=len(request.prompt), ttft=ttft,
                 queue_depth=tick.queue_depth))
+            # stream the first token NOW — its delivery latency is the
+            # ttft the admission event just charted
+            self._deliver(request.id, [admission.token])
+        for request_id, tokens in tick.emitted.items():
+            self._deliver(request_id, tokens)
+        for completion, _ in tick.expired:
+            self._close_stream(completion.request.id)
         for completion in tick.completed:
+            self._close_stream(completion.request.id)
             if completion.reason != 'cancelled':
                 self.producer.dispatch(RequestCompleted(
                     id=completion.request.id,
@@ -132,7 +179,8 @@ class InferenceService:
         self.producer.dispatch(ServeStepped(
             step=self.scheduler.steps, active=tick.active,
             queue_depth=tick.queue_depth, emitted=step_tokens,
-            tokens_per_sec=self._emitted / elapsed if elapsed else 0.0))
+            tokens_per_sec=self._emitted / elapsed if elapsed else 0.0,
+            sampled=self.engine.sampled_rows))
 
     def run_until_idle(self, max_steps: int = 10_000) -> dict:
         """Step until every request completes; returns request id ->

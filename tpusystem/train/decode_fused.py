@@ -29,6 +29,14 @@ Scope: the unrolled dense GPT-2 family (``fused_unsupported_reason``
 names the exact gate). Llama/MoE/scanned stacks fall back to the flax
 path under ``decode_impl='auto'`` and raise under an explicit
 ``'fused'``.
+
+Sampling: the fused step's contract ends at the logits it exposes —
+:class:`tpusystem.serve.Engine` applies
+:func:`tpusystem.train.generate.sample_token` (seeded counter-based
+sampling, temperature/top-k/top-p, grammar masks) to those logits
+inside the SAME jitted program, so sampled decode through the fused
+chain needs no gate here and stays bitwise-identical to the flax step's
+sampled stream wherever greedy is token-exact.
 """
 
 from __future__ import annotations

@@ -142,6 +142,61 @@ def _sample(logits, temperature: float, rng):
     return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
 
+def sampling_key(seed, position):
+    """Threefry counter key for one token slot: a pure function of
+    ``(seed, position)``. The serving engine derives every sampled
+    token's key this way, so the RNG carries NO mutable state — a
+    journal that records the emitted prefix (and the request's seed)
+    already records everything replay needs, and the same
+    ``(seed, position)`` pair reproduces the same key on any engine,
+    any process, any host."""
+    return jax.random.fold_in(jax.random.PRNGKey(seed), position)
+
+
+def sample_token(logits, seed, position, temperature, top_k, top_p, mask):
+    """Deterministically sample ONE token from one ``[vocab]`` logits row.
+
+    The single sampling primitive shared by the serving engine's jitted
+    decode step, its prefill programs, and the speculative verify — so a
+    token's identity is a pure function of
+    ``(logits, seed, position, temperature, top_k, top_p, mask)`` and
+    nothing else. Contract pins:
+
+    * ``temperature == 0`` (or ``top_k == 1``) reproduces greedy argmax
+      bitwise — both branches run under ``jnp.where``, so the same
+      compiled program serves greedy and sampled rows side by side.
+    * ``top_k > 0`` keeps the k highest logits; ``top_p < 1`` keeps the
+      smallest prefix of the sorted distribution whose mass *before*
+      each token stays under ``top_p`` (the first token always
+      survives). Ties break by ``jnp.argsort``'s stable order —
+      deterministic across runs and devices.
+    * ``mask`` (bool ``[vocab]``) zeroes disallowed tokens before
+      everything else — the grammar/JSON structured-output hook. An
+      all-``False`` mask is a caller error (validated host-side).
+
+    Scalar args should arrive as jnp-typed values (``jnp.uint32`` seed,
+    ``jnp.int32`` position/top_k, ``jnp.float32`` temperature/top_p) so
+    jitted callers never retrace on Python scalar weak types. Vmaps
+    over rows: every arg but ``logits``/``mask`` is per-row scalar."""
+    logits = jnp.where(mask, logits.astype(jnp.float32), -jnp.inf)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # the 1e-6 floor keeps the temperature==0 branch finite (its value
+    # is discarded by the final where, but NaNs would poison both sides)
+    scaled = logits / jnp.maximum(temperature, jnp.float32(1e-6))
+    order = jnp.argsort(-scaled)                     # stable: ties by id
+    ranked = jnp.take(scaled, order)
+    rank = jnp.arange(logits.shape[-1])
+    keep = jnp.where(top_k > 0, rank < top_k, True)
+    probs = jax.nn.softmax(ranked)
+    mass_before = jnp.cumsum(probs) - probs
+    keep = keep & (mass_before < top_p)
+    filtered = jnp.zeros_like(scaled).at[order].set(
+        jnp.where(keep, ranked, -jnp.inf))
+    sampled = jax.random.categorical(
+        sampling_key(seed, position), filtered).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
 def generate(module, params, prompt, *, steps: int,
              temperature: float = 0.0, rng=None,
              stream_dtype: str = 'auto', decode_impl: str = 'auto'):
