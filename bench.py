@@ -258,6 +258,17 @@ def fleet_recovery_row() -> None:
     _overlap_probe_row('serve_fleet.py', 'fleet_recovery_seconds')
 
 
+def router_failover_row() -> None:
+    """The router-failover MTTR row: wall seconds from killing the
+    ACTIVE Router mid-stream to the first completed token under the
+    warm standby, hot journal replay vs cold health sweep
+    (`benchmarks/serve_failover.py headline`; the crash-recoverable
+    Router of `tpusystem/serve/fleet.py` — the lease fence and the
+    recovery replay are both inside the timed window, and both arms
+    drain token-exact vs an uninterrupted fleet)."""
+    _overlap_probe_row('serve_failover.py', 'router_failover_seconds')
+
+
 def serve_disagg_ttft_row() -> None:
     """The disaggregated-serving head-of-line row: p99 submit→first-token
     over the SHORT requests of a mixed long:short workload, prefill-role
@@ -656,6 +667,7 @@ if __name__ == '__main__':
     serve_sampled_row()
     serve_recovery_row()
     fleet_recovery_row()
+    router_failover_row()
     serve_disagg_ttft_row()
     embedding_row()
     serve_ttft_row()

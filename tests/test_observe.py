@@ -174,6 +174,9 @@ TB_EXEMPT = {
     'TokenStreamed',        # per-token volume would swamp the board;
                             # TTFT and latency ride RequestAdmitted /
                             # RequestCompleted, throughput ServeStepped
+    'RouterDeposed',        # the deposed zombie exits 47 before any board
+                            # flush; the standby's RouterTakeover charts
+                            # the takeover, WorkerExited the halt verdict
     'WorkerRelaunched',     # WorkerExited's per-rank exit chart already
                             # counts every relaunch verdict
     'WorldResizeProposed',  # proposals can outnumber commits under churn;

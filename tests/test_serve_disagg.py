@@ -34,9 +34,10 @@ from tpusystem.observe.trace import connected_traces
 from tpusystem.parallel import MeshSpec, decode_tp_plan
 from tpusystem.parallel.chaos import PreemptionWave
 from tpusystem.parallel.multihost import Loopback
+from tpusystem.checkpoint.memstore import MemStore
 from tpusystem.serve import (Engine, HandoffCorrupt, KVHandoff, KVStripStore,
                              PagedKVCache, ReplicaHandle, Request, RoleMismatch,
-                             Router, Scheduler, ServingReplica,
+                             Router, SamplingParams, Scheduler, ServingReplica,
                              engine_unsupported_reason, fetch_handoff,
                              kv_namespace, pack_handoff, pool_shardings,
                              unpack_handoff)
@@ -437,9 +438,11 @@ class TestAuditUnderAdoptChurn:
 
 
 def role_fleet(module, params, clock, roles, *, wire=None, tracer=False,
-               producer=None, rows=2, **engine_knobs):
+               producer=None, rows=2, clients=None, **engine_knobs):
     """One replica per role string; a shared Loopback ``wire`` puts the
-    handoffs on the blob plane. Returns (router, handles, tracers)."""
+    handoffs on the blob plane; ``clients`` gives each replica a journal
+    store that outlives a kill (the supervisor-RAM analogue). Returns
+    (router, handles, tracers)."""
     handles, tracers = [], []
     for index, role in enumerate(roles):
         t = Tracer(f'rep{index}', clock=clock) if tracer else None
@@ -451,7 +454,8 @@ def role_fleet(module, params, clock, roles, *, wire=None, tracer=False,
                        **engine_knobs),
                 clock=clock, tracer=t, prefill_only=(role == 'prefill'))
         replica = ServingReplica(build, identity=f'rep{index}',
-                                 clock=clock, role=role)
+                                 clock=clock, role=role,
+                                 client=clients[index] if clients else None)
         handles.append(ReplicaHandle(replica, transport=wire, rank=0))
     router_tracer = Tracer('router', clock=clock) if tracer else None
     router = Router(handles, clock=clock, tracer=router_tracer,
@@ -477,6 +481,41 @@ def mixed_requests(seed=7, n=6):
     budgets = (8, 6, 9, 5, 7, 8, 6, 9, 7)[:n]
     return [(f'r{i}', rng.integers(0, 256, (k,)).tolist(), b)
             for i, (k, b) in enumerate(zip(lengths, budgets))]
+
+
+def sampled_specs(seed=13, n=5):
+    """Mixed greedy + seeded-sampled prompts sharing a system-prompt
+    head, so ``share_prefix=True`` radix hits ride the drill too."""
+    rng = np.random.default_rng(seed)
+    head = rng.integers(0, 256, (10,)).tolist()
+    specs = []
+    for i, k in enumerate((3, 5, 2, 4, 6)[:n]):
+        tail = rng.integers(0, 256, (k,)).tolist()
+        sampling = (dict(temperature=0.7, seed=300 + i, top_k=16)
+                    if i % 2 == 0 else None)
+        specs.append((f's{i}', head + tail, 5 + (i % 3), sampling))
+    return specs
+
+
+def sampled_requests(specs):
+    """Fresh Request objects from specs (one set per fleet — requests
+    must never be shared between the reference and the chaos run)."""
+    return [Request(rid, list(prompt), budget,
+                    sampling=None if sampling is None
+                    else SamplingParams(**sampling))
+            for rid, prompt, budget, sampling in specs]
+
+
+def sampled_reference(module, params, clock, specs):
+    def build():
+        return Scheduler(Engine(module, params, rows=2, block_size=8,
+                                share_prefix=True), clock=clock)
+    router = Router([ReplicaHandle(ServingReplica(build, identity='colo',
+                                                  clock=clock))],
+                    clock=clock)
+    for request in sampled_requests(specs):
+        router.submit(request)
+    return router.run_until_idle()
 
 
 class TestRoleFleet:
@@ -695,6 +734,101 @@ class TestRoleChaosDrill:
             assert completion.tokens == reference[rid].tokens, rid
             assert completion.reason == reference[rid].reason, rid
         # hot rows landed on the decode survivor, not the prefill tier
+        assert handles[0].scheduler.engine.active_rows == 0
+
+    def test_kill_prefill_between_export_and_ship_token_exact(self, gpt2):
+        """The undrilled window: the prefill replica dies AFTER
+        ``export_prefill`` filled its outbox but BEFORE the router
+        shipped a single strip. No prefill survivor exists, so every
+        row re-prefills cold on the decode tier (the colocated degrade
+        — role is placement policy, not capability), token-exact with
+        ``share_prefix=True`` + seeded sampling in the pot."""
+        from tpusystem.observe.events import RequestRerouted
+        module, params = gpt2
+        specs = sampled_specs()
+        clock = FakeClock()
+        reference = sampled_reference(module, params, clock, specs)
+        producer = Producer()
+        reroutes = witness(producer, RequestRerouted)
+        router, handles, _ = role_fleet(
+            module, params, clock, ('prefill', 'decode', 'decode'),
+            producer=producer, share_prefix=True,
+            clients=[MemStore() for _ in range(3)])
+        for request in sampled_requests(specs):
+            assert router.submit(request) == 'rep0'
+        # drive the prefill replica's own loop WITHOUT the router pump:
+        # strips are exported into the outbox but never shipped
+        for _ in range(8):
+            if handles[0].replica.scheduler.outbox:
+                break
+            handles[0].replica.step()
+        exported = [handoff.request.id
+                    for handoff in handles[0].replica.scheduler.outbox]
+        assert exported, 'prefill never exported a strip'
+        handles[0].kill()
+        results = router.run_until_idle()
+        assert not handles[0].healthy
+        assert set(results) == {rid for rid, _, _, _ in specs}
+        for rid, completion in results.items():
+            assert completion.tokens == reference[rid].tokens, rid
+            assert completion.reason == reference[rid].reason, rid
+        # every row (exported-but-unshipped AND still-queued) re-homed
+        # cold onto a decode replica — never silently dropped
+        moved = {event.id: event for event in reroutes}
+        assert set(moved) == set(results)
+        assert all(event.where == 'cold'
+                   and event.target in ('rep1', 'rep2')
+                   for event in moved.values())
+
+    def test_kill_decode_holding_seated_handoffs_token_exact(self, gpt2):
+        """The other undrilled window: a decode replica dies HOLDING
+        rows it seated from shipped KV strips. Its journal (in the
+        supervisor-RAM store the kill leaves behind) replays the rows
+        HOT onto the decode survivor — emitted prefixes re-prefilled,
+        never routed to the prefill tier — token-exact with
+        ``share_prefix=True`` + seeded sampling."""
+        from tpusystem.observe.events import RequestRerouted
+        module, params = gpt2
+        specs = sampled_specs(seed=17)
+        clock = FakeClock()
+        reference = sampled_reference(module, params, clock, specs)
+        producer = Producer()
+        reroutes = witness(producer, RequestRerouted)
+        router, handles, _ = role_fleet(
+            module, params, clock, ('prefill', 'decode', 'decode'),
+            producer=producer, share_prefix=True,
+            clients=[MemStore() for _ in range(3)])
+        for request in sampled_requests(specs):
+            router.submit(request)
+        victim, shipped = None, []
+        for _ in range(400):
+            if router.idle:
+                break
+            shipped.extend(router.step().handoffs)
+            if victim is None and shipped:
+                seated = [handle for handle in handles[1:]
+                          if handle.healthy
+                          and handle.scheduler.engine.active_rows > 0]
+                if seated:           # a decode replica holds seated rows
+                    victim = seated[0]
+                    victim.kill()
+        assert router.idle and victim is not None and not victim.healthy
+        assert handles[0].healthy, 'prefill replica must survive'
+        assert set(router.results) == {rid for rid, _, _, _ in specs}
+        for rid, completion in router.results.items():
+            assert completion.tokens == reference[rid].tokens, rid
+            assert completion.reason == reference[rid].reason, rid
+        # the journal replayed the seated rows hot, onto the survivor
+        # (still-queued cold rows may re-enter via the prefill front
+        # door instead — that is the role-aware cold rung, not a leak)
+        from_victim = [event for event in reroutes
+                       if event.origin == victim.name]
+        assert from_victim, 'the dead decode replica held no rows?'
+        survivor = ({'rep1', 'rep2'} - {victim.name}).pop()
+        hot = [event for event in from_victim if event.where == 'hot']
+        assert hot, 'no seated row re-homed hot'
+        assert all(event.target == survivor for event in hot)
+        # the prefill engine never seated a decode row
         assert handles[0].scheduler.engine.active_rows == 0
 
 

@@ -270,6 +270,63 @@ class PrefillHandoff:
 
 
 @event
+class HandoffCorrupted:
+    """A ``kv:{request}`` handoff failed its digest frame between the
+    prefill tier and a decode seat (:class:`tpusystem.serve.disagg.
+    HandoffCorrupt`): the payload is dropped and the router re-places
+    the request cold (re-prefill from the journaled prompt+prefix), so
+    the corruption costs latency, never tokens. Charted as the
+    ``serve/handoff_corrupt`` counter — a silently-re-placing fleet is
+    visible on the dashboard."""
+    id: str
+    origin: str                      # prefill replica that exported it
+    target: str                      # decode replica that refused it
+
+
+@event
+class RoleMismatched:
+    """A decode-carrying request (non-empty emitted prefix) was offered
+    to a prefill-only replica (:class:`tpusystem.serve.disagg.
+    RoleMismatch`): the placement is refused and retried on the decode
+    tier. Charted as the ``serve/role_mismatch`` counter; a nonzero
+    rate means the router's role map and the fleet disagree."""
+    id: str
+    replica: str
+    prefix: int
+
+
+@event
+class RouterTakeover:
+    """A (re)started router rebuilt the fleet's authoritative state:
+    ``source`` says where it came back from — ``'journal'`` (the
+    router journal on the memstore plane was readable: hot rebuild) or
+    ``'sweep'`` (journal absent/corrupt: cold rebuild from a health
+    sweep of the replicas' own journals). ``reseated`` routes kept
+    streaming on the replica that already held them, ``replaced`` were
+    re-placed (hot or cold), ``settled`` completions were recovered
+    into the idempotency table (nothing double-completes), ``handoffs``
+    in-flight KV payloads were re-queued for delivery."""
+    term: int
+    source: str                      # 'journal' | 'sweep'
+    reseated: int
+    replaced: int
+    settled: int
+    handoffs: int
+    seconds: float
+
+
+@event
+class RouterDeposed:
+    """A router observed a lease term higher than its own: a standby
+    fenced it and took over. The deposed router must halt (exit
+    ``ROUTER_FENCED_EXIT`` = 47, deliberately NOT restartable) rather
+    than keep placing requests against the new term — the split-brain
+    guard of the takeover protocol."""
+    term: int
+    observed: int
+
+
+@event
 class FleetResized:
     """The traffic-driven autoscaler changed the replica set: sustained
     backpressure ``'grow'``\\ s it through the provision seam (capacity

@@ -29,12 +29,13 @@ import time
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
                                       Backpressure, ElasticTimeline,
                                       EngineRestarted, FleetResized,
-                                      LoadShed, PrefillHandoff,
-                                      RecoveryTimeline,
+                                      HandoffCorrupted, LoadShed,
+                                      PrefillHandoff, RecoveryTimeline,
                                       RecsysEvaluated, ReplicaDiverged,
                                       ReplicaUnhealthy, RequestAdmitted,
                                       RequestExpired, RequestRerouted,
-                                      RolledBack, ServeStepped, Trained,
+                                      RoleMismatched, RolledBack,
+                                      RouterTakeover, ServeStepped, Trained,
                                       Validated, WorkerExited, WorldResized)
 from tpusystem.services.prodcon import Consumer, Depends
 
@@ -356,6 +357,44 @@ def tensorboard_consumer() -> Consumer:
         # plane — the interconnect cost of splitting prefill from decode
         board.add_scalar('fleet/handoff_bytes', float(event.bytes),
                          handoff_counts[0])
+
+    # disaggregation integrity + router takeover: each of these SHOULD
+    # chart flat at zero (corrupt handoffs and role mismatches are
+    # recovered typed, but a rising rate means the blob plane or the
+    # role map is sick); a takeover charts its MTTR ingredients
+    corrupt_counts = [0]
+    mismatch_counts = [0]
+    takeover_counts = [0]
+
+    @consumer.handler
+    def on_handoff_corrupted(event: HandoffCorrupted,
+                             board: SummaryWriter = Depends(writer)) -> None:
+        corrupt_counts[0] += 1
+        board.add_scalar('serve/handoff_corrupt', float(corrupt_counts[0]),
+                         corrupt_counts[0])
+
+    @consumer.handler
+    def on_role_mismatched(event: RoleMismatched,
+                           board: SummaryWriter = Depends(writer)) -> None:
+        mismatch_counts[0] += 1
+        board.add_scalar('serve/role_mismatch', float(mismatch_counts[0]),
+                         mismatch_counts[0])
+
+    @consumer.handler
+    def on_router_takeover(event: RouterTakeover,
+                           board: SummaryWriter = Depends(writer)) -> None:
+        takeover_counts[0] += 1
+        board.add_scalar('fleet/takeover_seconds', event.seconds,
+                         takeover_counts[0])
+        board.add_scalar('fleet/takeover_reseated', float(event.reseated),
+                         takeover_counts[0])
+        board.add_scalar('fleet/takeover_replaced', float(event.replaced),
+                         takeover_counts[0])
+        # 1.0 = the router journal survived (hot rebuild); 0.0 = the
+        # health sweep alone rebuilt the tables (cold)
+        board.add_scalar('fleet/takeover_hot',
+                         1.0 if event.source == 'journal' else 0.0,
+                         takeover_counts[0])
 
     @consumer.handler
     def on_recovery(event: RecoveryTimeline,

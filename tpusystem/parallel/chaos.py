@@ -49,7 +49,7 @@ from tpusystem.parallel.multihost import Hub, TcpTransport
 
 __all__ = ['Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
            'PreemptionWave', 'StalledStep', 'CorruptGrads', 'CorruptBatch',
-           'FlipParamBit']
+           'FlipParamBit', 'ChaosPick', 'pick_chaos']
 
 
 @dataclass
@@ -211,6 +211,36 @@ class ChaosHub(Hub):
         if verdict > 0:
             time.sleep(verdict)
         super()._fanout(frame, exclude=exclude, live_only=live_only)
+
+
+@dataclass(frozen=True)
+class ChaosPick:
+    """One drawn fleet-chaos scenario: kill ``component`` after router
+    tick ``step`` (see :func:`pick_chaos`)."""
+
+    component: str
+    step: int
+
+
+def pick_chaos(seed: int, components: tuple[str, ...] | list[str], *,
+               lo: int = 1, hi: int = 8) -> ChaosPick:
+    """Draw the victim for one fleet chaos-certification run.
+
+    The randomized half of ``certify_fleet`` (the other half is the
+    invariant check): a uniformly-chosen component from ``components``
+    (router, standby, a prefill or decode replica, the supervisor...) is
+    killed after a uniformly-chosen router tick in ``[lo, hi]``. Both
+    draws come from one ``random.Random(seed)`` in a fixed order, so a
+    seed IS the scenario — a red run replays exactly from its seed, the
+    same discipline as :class:`Faults`.
+    """
+    if not components:
+        raise ValueError('need at least one component to pick from')
+    if lo < 0 or hi < lo:
+        raise ValueError(f'need 0 <= lo <= hi, got [{lo}, {hi}]')
+    rng = random.Random(seed)
+    component = components[rng.randrange(len(components))]
+    return ChaosPick(component=component, step=rng.randint(lo, hi))
 
 
 class WorkerKilled(RuntimeError):

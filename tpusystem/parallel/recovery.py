@@ -57,7 +57,12 @@ logger = logging.getLogger('tpusystem.recovery')
 # supervisors agreed a NEW world size and this worker must be relaunched
 # under the new world spec — restartable by definition (the relaunch IS
 # the resize), and distinct from 42/43 so the timeline and ledger can
-# tell a planned reshard from a fault. 1 is the generic non-restart
+# tell a planned reshard from a fault. 47 is a deposed serving router
+# (:class:`tpusystem.serve.fleet.RouterFenced`): a standby observed its
+# missed lease renewals, fenced the term, and took over — deliberately
+# NOT in RESTART_EXITS, because relaunching the old-term router would
+# split-brain placements against the new incumbent; the supervisor
+# halts it and the standby IS the restart. 1 is the generic non-restart
 # failure (an unrecognized exception is a bug, not a recoverable fault —
 # relaunching it forever would hide it).
 LOST_WORKER_EXIT = 42
@@ -65,6 +70,7 @@ PREEMPTED_EXIT = 43
 DIVERGED_EXIT = 44
 CRASH_LOOP_EXIT = 45
 RESIZED_EXIT = 46
+ROUTER_FENCED_EXIT = 47
 FAILURE_EXIT = 1
 RESTART_EXITS = frozenset({LOST_WORKER_EXIT, PREEMPTED_EXIT, RESIZED_EXIT})
 
@@ -172,9 +178,14 @@ def exit_for_restart(reason: BaseException) -> SystemExit:
     :data:`DIVERGED_EXIT` (44, from :class:`DivergenceError`) halts for
     triage.
 
-    Only the recovery exceptions map to contract codes. Anything
-    else — a plain ``ValueError``, ``KeyboardInterrupt``, an assertion —
-    is a *bug*, not a recoverable fault, and returns the generic
+    Only the recovery exceptions map to contract codes. An exception
+    from another layer can still opt into the contract by carrying an
+    integer ``exit_code`` attribute (the serving router's
+    :class:`~tpusystem.serve.fleet.RouterFenced` maps itself to
+    :data:`ROUTER_FENCED_EXIT` this way — this module cannot import
+    ``serve`` without a layering cycle). Anything else — a plain
+    ``ValueError``, ``KeyboardInterrupt``, an assertion — is a *bug*,
+    not a recoverable fault, and returns the generic
     :data:`FAILURE_EXIT`: mapping unknown exceptions to a restartable
     code (the old behavior) would relaunch a deterministic crash forever.
 
@@ -191,6 +202,8 @@ def exit_for_restart(reason: BaseException) -> SystemExit:
         code = RESIZED_EXIT
     elif isinstance(reason, DivergenceError):
         code = DIVERGED_EXIT
+    elif isinstance(getattr(reason, 'exit_code', None), int):
+        code = reason.exit_code          # e.g. RouterFenced -> 47
     else:
         code = FAILURE_EXIT
     try:   # the black box must never cost the contract its exit code

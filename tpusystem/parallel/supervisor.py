@@ -69,7 +69,8 @@ from typing import TYPE_CHECKING, Any, Callable
 from tpusystem.parallel.multihost import BlobError
 from tpusystem.parallel.recovery import (CRASH_LOOP_EXIT, DIVERGED_EXIT,
                                          FAILURE_EXIT, PREEMPTED_EXIT,
-                                         RESIZED_EXIT, RESTART_EXITS)
+                                         RESIZED_EXIT, RESTART_EXITS,
+                                         ROUTER_FENCED_EXIT)
 
 if TYPE_CHECKING:  # deferred at runtime: memstore pulls in the (orbax-
     # backed) checkpoint package, which must not tax `import
@@ -82,7 +83,7 @@ __all__ = ['Supervisor']
 
 _CODE_NAMES = {0: 'completed', FAILURE_EXIT: 'failure', 42: 'worker-lost',
                43: 'preempted', 44: 'diverged', CRASH_LOOP_EXIT: 'crash-loop',
-               RESIZED_EXIT: 'resized'}
+               RESIZED_EXIT: 'resized', ROUTER_FENCED_EXIT: 'router-fenced'}
 
 # signal deaths relaunch (a SIGKILLed worker IS the worker-lost case) —
 # EXCEPT these: SIGINT (^C) and SIGQUIT (^\) are *operator intent*, a

@@ -50,6 +50,8 @@ Layers, bottom up:
   (docs/serving.md "Disaggregated prefill/decode").
 """
 
+from tpusystem.serve.certify import (CertifyReport, FleetHarness,
+                                     certify_fleet)
 from tpusystem.serve.disagg import (HandoffCorrupt, KVHandoff, KVStripStore,
                                     RoleMismatch, fetch_handoff,
                                     kv_namespace, pack_handoff,
@@ -61,31 +63,36 @@ from tpusystem.serve.engine import (Admission, Engine, SamplingParams,
                                     prefill_bucket)
 from tpusystem.serve.failover import (EngineStalled, JournalCorrupt,
                                       ReplayReport, RequestJournal,
-                                      ServingReplica, StepWatchdog,
-                                      Watermarks, journal_identity,
-                                      recover_journal, replay)
+                                      RouterJournal, ServingReplica,
+                                      StepWatchdog, Watermarks,
+                                      journal_identity, recover_journal,
+                                      recover_router_journal, replay,
+                                      router_identity)
 from tpusystem.serve.fleet import (AutoscalePolicy, FleetSaturated,
                                    FleetTick, NoHealthyReplica,
                                    ReplicaDead, ReplicaHandle, RoutePolicy,
-                                   Router)
+                                   Router, RouterFenced, RouterLease)
 from tpusystem.serve.kvcache import (TRASH_BLOCK, PagedKVCache,
                                      adopt_prefill, pool_shardings,
                                      write_tables)
 from tpusystem.serve.scheduler import (Completion, QueueFull, Request,
                                        Scheduler, Tick, serve_levers)
-from tpusystem.serve.service import InferenceService
+from tpusystem.serve.service import FleetClient, InferenceService
 
 __all__ = ['Engine', 'Admission', 'StepReport', 'Saturated',
            'SamplingParams', 'UnseededSampling',
            'engine_unsupported_reason', 'prefill_bucket',
            'PagedKVCache', 'TRASH_BLOCK', 'adopt_prefill', 'write_tables',
            'Scheduler', 'Request', 'Completion', 'Tick', 'serve_levers',
-           'QueueFull', 'InferenceService',
+           'QueueFull', 'InferenceService', 'FleetClient',
            'EngineStalled', 'JournalCorrupt', 'RequestJournal',
            'ReplayReport', 'ServingReplica', 'StepWatchdog', 'Watermarks',
            'journal_identity', 'recover_journal', 'replay',
-           'Router', 'ReplicaHandle', 'RoutePolicy', 'AutoscalePolicy',
+           'RouterJournal', 'router_identity', 'recover_router_journal',
+           'Router', 'RouterFenced', 'RouterLease',
+           'ReplicaHandle', 'RoutePolicy', 'AutoscalePolicy',
            'FleetTick', 'ReplicaDead', 'NoHealthyReplica', 'FleetSaturated',
            'KVHandoff', 'KVStripStore', 'HandoffCorrupt', 'RoleMismatch',
            'kv_namespace', 'pack_handoff', 'unpack_handoff', 'fetch_handoff',
-           'pool_shardings']
+           'pool_shardings',
+           'CertifyReport', 'FleetHarness', 'certify_fleet']

@@ -85,7 +85,8 @@ logger = logging.getLogger('tpusystem.serve.failover')
 
 __all__ = ['EngineStalled', 'JournalCorrupt', 'journal_identity',
            'JournalRow', 'RequestJournal', 'recover_journal', 'replay',
-           'ReplayReport', 'StepWatchdog', 'Watermarks', 'ServingReplica']
+           'ReplayReport', 'StepWatchdog', 'Watermarks', 'ServingReplica',
+           'router_identity', 'RouterJournal', 'recover_router_journal']
 
 
 class EngineStalled(RuntimeError):
@@ -320,6 +321,144 @@ def recover_journal(identity: str, clients: Any) -> tuple[int, list] | None:
 
 
 # ---------------------------------------------------------------------------
+# the router journal — same framing and wire discipline as the request
+# journal, different schema: the router's authoritative fleet state
+
+
+def router_identity(name: str = 'router') -> str:
+    """The memstore identity a router's state journal travels under —
+    its own namespace (``router:{name}``) beside ``journal:{identity}``,
+    riding the identical push/replicate/buddy machinery."""
+    return f'router:{name}'
+
+
+class RouterJournal:
+    """The fleet router's crash journal: placements, orphans, in-flight
+    handoffs, settled completions, brownout/cooldown flags — everything a
+    relaunched (or standby-takeover) router needs to rebuild without
+    asking clients to resubmit.
+
+    The schema is the router's business (:meth:`tpusystem.serve.fleet.
+    Router.snapshot` builds the state dict, with timestamps converted to
+    clock-portable waited-seconds at pack time); this class owns only the
+    :class:`RequestJournal` disciplines — digest-framed pickle so a torn
+    copy reads as absent (:exc:`JournalCorrupt`), a journal-owned
+    monotonic tick so pushes never regress in the store, cadence-gated
+    replication with log-once degrade (the journal is a recovery
+    accelerator, never allowed to take routing down).
+    """
+
+    def __init__(self, name: str = 'router', *, client: Any = None,
+                 cadence: int = 1) -> None:
+        if cadence < 1:
+            raise ValueError(f'cadence must be >= 1 ticks, got {cadence}')
+        self.name = name
+        self.identity = router_identity(name)
+        self.client = client
+        self.cadence = cadence
+        self.tick = 0                 # monotonic across relaunches (seeded
+        self.pushes = 0               # from the recovered journal's tick)
+        # lease term, when the router holds one: the push step encodes
+        # term * 1_000_000 + tick, so the store's monotonic-step rule
+        # fences a deposed router's journal pushes exactly like its
+        # lease renewals — a zombie can never overwrite the incumbent's
+        # state (the payload still carries the raw tick)
+        self.term = 0
+        self._push_failed = False
+
+    def pack(self, state: dict) -> bytes:
+        payload = pickle.dumps((self.tick, dict(state)),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        return _blob_digest(payload).encode('ascii') + b':' + payload
+
+    @staticmethod
+    def unpack(data: bytes) -> tuple[int, dict]:
+        """``(tick, state)`` from :meth:`pack` bytes; raises
+        :exc:`JournalCorrupt` when the digest or shape does not verify."""
+        digest, sep, payload = bytes(data).partition(b':')
+        if not sep or _blob_digest(payload).encode('ascii') != digest:
+            raise JournalCorrupt(
+                'router journal bytes failed their digest check — torn or '
+                'corrupted copy; treating as absent')
+        try:
+            tick, state = pickle.loads(payload)
+            if not isinstance(state, dict):
+                raise TypeError(f'state is {type(state).__name__}, not dict')
+        except Exception as error:
+            raise JournalCorrupt(
+                f'router journal payload does not decode ({error}); '
+                f'treating as absent') from error
+        return int(tick), state
+
+    def observe_tick(self, state: Callable[[], dict]) -> None:
+        """One router step elapsed: advance the tick and replicate at the
+        cadence. ``state`` is a thunk so off-cadence ticks never pay the
+        snapshot cost."""
+        self.tick += 1
+        if self.client is None or self.tick % self.cadence:
+            return
+        self.replicate(state())
+
+    def replicate(self, state: dict) -> bool:
+        """Push the packed state now (also called directly for an
+        off-cadence flush, e.g. right before a planned handover)."""
+        if self.client is None:
+            return False
+        packed = self.pack(state)
+        step = self.term * 1_000_000 + self.tick
+        why = 'push not acknowledged'
+        try:
+            push = getattr(self.client, 'push', None)
+            if push is not None:
+                ok = bool(push(self.identity, step, packed))
+            else:             # bare MemStore (in-process drills, bench)
+                self.client.put(self.identity, step, packed)
+                ok = True
+        except (OSError, ValueError) as error:
+            ok, why = False, str(error)
+        if ok:
+            self.pushes += 1
+            if self._push_failed:
+                logger.info('router journal for %r recovered at tick %d',
+                            self.name, self.tick)
+            self._push_failed = False
+        else:
+            if not self._push_failed:
+                logger.warning(
+                    'router journal for %r failed at tick %d (%s); routing '
+                    'continues — a takeover now rebuilds from the last '
+                    'verified copy plus a health sweep', self.name,
+                    self.tick, why)
+            self._push_failed = True
+        return ok
+
+
+def recover_router_journal(name: str, clients: Any) -> tuple[int, dict] | None:
+    """Fetch and verify the newest router journal for ``name`` from the
+    first client with an intact copy — ``clients`` in preference order,
+    :func:`recover_journal`'s contract: a corrupt copy logs and falls
+    through to the next client, never restores."""
+    for client in clients:
+        if client is None:
+            continue
+        try:
+            entry = client.fetch(router_identity(name))
+        except OSError as error:
+            logger.warning('router journal fetch for %r failed (%s); '
+                           'trying the next replica', name, error)
+            continue
+        if entry is None:
+            continue
+        try:
+            return RouterJournal.unpack(entry.blob)
+        except JournalCorrupt as error:
+            logger.warning('router journal for %r at tick %d rejected (%s); '
+                           'trying the next replica', name,
+                           getattr(entry, 'step', -1), error)
+    return None
+
+
+# ---------------------------------------------------------------------------
 # replay
 
 
@@ -343,11 +482,24 @@ def replay(scheduler: Any, rows: list, *,
     narrated as a ``RequestReplayed`` event. A row whose deadline already
     passed during the outage is still queued; the scheduler's ordinary
     expiry retires it with the truthful ``'expired'`` verdict on the next
-    step (replay never silently drops)."""
-    from tpusystem.observe.events import RequestReplayed
+    step (replay never silently drops). A decode-carrying row replayed
+    onto a prefill-only scheduler is a wiring bug, not a recoverable
+    fault: the typed :exc:`~tpusystem.serve.disagg.RoleMismatch`
+    re-raises, narrated as a ``RoleMismatched`` event first so the
+    dashboard's ``serve/role_mismatch`` counter sees it."""
+    from tpusystem.observe.events import RequestReplayed, RoleMismatched
+    from tpusystem.serve.disagg import RoleMismatch
     result = ReplayReport()
     for request, waited, emitted in rows:
-        scheduler.restore(request, waited=waited, prefix=emitted)
+        try:
+            scheduler.restore(request, waited=waited, prefix=emitted)
+        except RoleMismatch:
+            if producer is not None:
+                producer.dispatch(RoleMismatched(
+                    id=request.id, replica=scheduler.journal.identity
+                    if getattr(scheduler, 'journal', None) is not None
+                    else 'replay', prefix=len(emitted)))
+            raise
         where = 'hot' if emitted else 'cold'
         (result.replayed if emitted else result.resubmitted).append(
             request.id)

@@ -77,18 +77,25 @@ import logging
 import time
 from typing import Any, Callable
 
+import pickle
+
 from tpusystem.parallel.chaos import WorkerKilled
-from tpusystem.serve.disagg import (HandoffCorrupt, kv_namespace,
-                                    pack_handoff, unpack_handoff)
-from tpusystem.serve.failover import Watermarks, recover_journal
+from tpusystem.parallel.multihost import _blob_digest
+from tpusystem.parallel.recovery import ROUTER_FENCED_EXIT
+from tpusystem.serve.disagg import (HandoffCorrupt, RoleMismatch,
+                                    kv_namespace, pack_handoff,
+                                    unpack_handoff)
+from tpusystem.serve.failover import (JournalCorrupt, RouterJournal,
+                                      Watermarks, recover_journal,
+                                      recover_router_journal)
 from tpusystem.serve.scheduler import QueueFull
 from tpusystem.serve.engine import Saturated, UnseededSampling
 
 logger = logging.getLogger('tpusystem.serve.fleet')
 
 __all__ = ['ReplicaDead', 'NoHealthyReplica', 'FleetSaturated',
-           'RoutePolicy', 'AutoscalePolicy', 'ReplicaHandle', 'FleetTick',
-           'Router']
+           'RouterFenced', 'RouterLease', 'RoutePolicy', 'AutoscalePolicy',
+           'ReplicaHandle', 'FleetTick', 'Router']
 
 
 class ReplicaDead(RuntimeError):
@@ -115,6 +122,183 @@ class FleetSaturated(RuntimeError):
     thing a degrading fleet stops accepting, BEFORE the backlog
     collapses into shedding requests that could still meet their
     deadlines."""
+
+
+class RouterFenced(RuntimeError):
+    """This router's lease term was superseded: a standby observed its
+    missed renewals, fenced the term, and took over. The deposed router
+    must STOP — keep placing requests against the new incumbent and the
+    fleet split-brains. ``exit_code`` maps it into the supervisor
+    contract (:data:`~tpusystem.parallel.recovery.ROUTER_FENCED_EXIT`,
+    deliberately not restartable: the standby IS the restart)."""
+
+    exit_code = ROUTER_FENCED_EXIT
+
+    def __init__(self, term: int, observed: int):
+        super().__init__(
+            f'router lease term {term} fenced by term {observed}: a '
+            f'standby took over; halt (exit {ROUTER_FENCED_EXIT}) instead '
+            f'of split-braining placements against the new incumbent')
+        self.term = term
+        self.observed = observed
+
+
+class RouterLease:
+    """Monotonic-term lease over the memstore plane — the split-brain
+    guard of warm-standby router takeover.
+
+    No new consensus system: the lease record is one digest-framed blob
+    under ``router-lease:{name}``, pushed with the memstore step encoded
+    as ``term * 1_000_000 + count`` — the store's monotonic-step rule
+    (an older step never replaces a newer one) then IS the fence: once a
+    standby publishes ``term + 1``, every renewal the deposed router
+    pushes is too old to land. The echo discipline of
+    :mod:`tpusystem.parallel.elastic` closes the loop: after every push
+    the holder re-reads the record, and a higher term echoed back is the
+    typed :exc:`RouterFenced` verdict (exit 47 under a supervisor).
+
+    Two sides, one clock (injectable — the tier-1 drills run with zero
+    real sleeps):
+
+    * the **active** router calls :meth:`renew` once per fleet tick; the
+      lease self-gates to ``renew_every`` seconds, so tick rate never
+      hammers the store. A push that cannot reach the plane degrades
+      (log-once) — the lease is a takeover accelerator, never allowed to
+      take routing down on a store hiccup.
+    * the **standby** calls :meth:`watch` on its own loop: renewals
+      advancing reset its patience; a record silent for ``miss_after``
+      seconds returns True — fence with :meth:`acquire` (term + 1),
+      rebuild via :meth:`Router.recover`, and serve.
+    """
+
+    def __init__(self, name: str = 'router', *, client: Any,
+                 holder: str = 'router', renew_every: float = 1.0,
+                 miss_after: float = 3.0,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        if renew_every <= 0 or miss_after <= 0:
+            raise ValueError('renew_every and miss_after must be positive '
+                             'seconds')
+        self.name = name
+        self.identity = f'router-lease:{name}'
+        self.client = client
+        self.holder = holder
+        self.renew_every = renew_every
+        self.miss_after = miss_after
+        self._clock = clock
+        self.term = 0
+        self.count = 0
+        self._last_renewed: float | None = None
+        self._seen: tuple[int, int] | None = None
+        self._seen_at: float | None = None
+        self._push_failed = False
+
+    # ------------------------------------------------------------- wire
+
+    def _pack(self) -> bytes:
+        payload = pickle.dumps((self.term, self.count, self.holder),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        return _blob_digest(payload).encode('ascii') + b':' + payload
+
+    @staticmethod
+    def _unpack(data: bytes) -> tuple[int, int, str]:
+        digest, sep, payload = bytes(data).partition(b':')
+        if not sep or _blob_digest(payload).encode('ascii') != digest:
+            raise JournalCorrupt('lease bytes failed their digest check — '
+                                 'torn copy; treating as absent')
+        try:
+            term, count, holder = pickle.loads(payload)
+            return int(term), int(count), str(holder)
+        except Exception as error:
+            raise JournalCorrupt(f'lease payload does not decode ({error}); '
+                                 f'treating as absent') from error
+
+    def _push(self) -> bool:
+        step = self.term * 1_000_000 + self.count
+        try:
+            push = getattr(self.client, 'push', None)
+            if push is not None:
+                ok = bool(push(self.identity, step, self._pack()))
+            else:             # bare MemStore (in-process drills, bench)
+                self.client.put(self.identity, step, self._pack())
+                ok = True
+        except (OSError, ValueError):
+            # ValueError includes the store's non-monotonic-step refusal:
+            # a zombie term's renewal is too old to land — the echo read
+            # below turns that into the RouterFenced verdict
+            ok = False
+        if ok:
+            self._push_failed = False
+        elif not self._push_failed:
+            logger.warning('lease push for %r failed at term %d; routing '
+                           'continues degraded', self.name, self.term)
+            self._push_failed = True
+        return ok
+
+    def observe(self) -> tuple[int, int, str] | None:
+        """The newest verified lease record ``(term, count, holder)``,
+        or None when the plane is unreachable or the copy is torn."""
+        try:
+            entry = self.client.fetch(self.identity)
+        except OSError:
+            return None
+        if entry is None:
+            return None
+        try:
+            return self._unpack(entry.blob)
+        except JournalCorrupt:
+            return None
+
+    # ----------------------------------------------------------- holder
+
+    def acquire(self) -> int:
+        """Fence every prior term and become the incumbent: publish
+        ``observed term + 1``. Raises :exc:`RouterFenced` if another
+        acquirer won the race (the echo reads back a higher term)."""
+        observed = self.observe()
+        self.term = (observed[0] if observed is not None else 0) + 1
+        self.count = 0
+        self._push()
+        echo = self.observe()
+        if echo is not None and echo[0] > self.term:
+            raise RouterFenced(self.term, echo[0])
+        self._last_renewed = self._clock()
+        return self.term
+
+    def renew(self) -> None:
+        """One holder heartbeat (self-gated to ``renew_every``). Raises
+        :exc:`RouterFenced` the moment a higher term is observed — the
+        zombie-router guard."""
+        if self.term < 1:
+            raise ValueError('renew() before acquire(): the lease has no '
+                             'term to renew')
+        now = self._clock()
+        if (self._last_renewed is not None
+                and now - self._last_renewed < self.renew_every):
+            return
+        self.count += 1
+        self._last_renewed = now
+        self._push()
+        echo = self.observe()
+        if echo is not None and echo[0] > self.term:
+            raise RouterFenced(self.term, echo[0])
+
+    # ---------------------------------------------------------- standby
+
+    def watch(self) -> bool:
+        """Standby-side staleness probe: True when the incumbent's
+        record has not advanced for ``miss_after`` seconds (time to
+        fence and take over). An unreachable plane never trips it — a
+        store outage must not look like a router death."""
+        now = self._clock()
+        observed = self.observe()
+        if observed is None:
+            return False
+        seen = (observed[0], observed[1])
+        if seen != self._seen:
+            self._seen = seen
+            self._seen_at = now
+            return False
+        return now - self._seen_at >= self.miss_after
 
 
 # the exception classes the router reads as "this replica is dead", as
@@ -423,6 +607,17 @@ class Router:
         producer: event bus for ``ReplicaUnhealthy`` /
             ``RequestRerouted`` / ``FleetResized`` + the fleet-scope
             ``LoadShed``/``Backpressure`` narration.
+        journal: a :class:`~tpusystem.serve.RouterJournal` — every
+            ``cadence`` ticks the router's authoritative state
+            (placements, orphans, in-flight handoffs, settled results,
+            brownout/cooldown) replicates to the memstore plane, and a
+            relaunched or standby router rebuilds it with
+            :meth:`recover`. None = crash recovery falls back to the
+            health sweep alone (cold rebuild).
+        lease: a :class:`RouterLease` this router holds while serving —
+            renewed once per tick (self-gated); a higher term observed
+            raises :exc:`RouterFenced` out of :meth:`step` (exit 47
+            under a supervisor: the standby has taken over).
         clock: THE fleet clock — must be the same callable every
             replica and scheduler in the fleet runs on (enforced per
             replica by ``ServingReplica``; timeouts, hedging, shedding
@@ -436,6 +631,8 @@ class Router:
                  provision: Callable[[], ReplicaHandle] | None = None,
                  release: Callable[[ReplicaHandle], None] | None = None,
                  producer: Any = None, tracer: Any = None,
+                 journal: RouterJournal | None = None,
+                 lease: RouterLease | None = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         self.handles = [handle if isinstance(handle, ReplicaHandle)
                         else ReplicaHandle(handle) for handle in handles]
@@ -461,6 +658,8 @@ class Router:
         # tracing work on any path.
         self.tracer = tracer
         self._trace_roots: dict[str, Any] = {}
+        self.journal = journal
+        self.lease = lease
         self._clock = clock
         self.results: dict[str, Any] = {}
         self.brownout = False
@@ -537,7 +736,18 @@ class Router:
         refused typed (:exc:`~tpusystem.serve.UnseededSampling`) before
         placement: every fleet robustness move — replay, reroute,
         hedging — relies on decode being reproducible, and unseeded
-        sampling is the one configuration that is not."""
+        sampling is the one configuration that is not.
+
+        Submission is **request-id idempotent**: a client resubmitting
+        after a router redial (the takeover contract) is a no-op —
+        already settled returns the ``'settled'`` sentinel (read the
+        result from :attr:`results`), still in flight returns its
+        current placement; neither double-places."""
+        if request.id in self.results:
+            return 'settled'
+        routed = self._routes.get(request.id)
+        if routed is not None:
+            return routed.handle
         sampling = getattr(request, 'sampling', None)
         if (sampling is not None and sampling.sampled
                 and sampling.seed is None):
@@ -739,6 +949,17 @@ class Router:
         for handle in targets:
             try:
                 handle.restore(request, waited=waited, prefix=emitted)
+            except RoleMismatch:
+                # the role guard fired: a decode-carrying row was offered
+                # to a prefill-only scheduler (the role map and the fleet
+                # disagree — should be unreachable through _targets).
+                # Narrate typed and try the next target; the dashboard's
+                # serve/role_mismatch counter charts the rate.
+                from tpusystem.observe.events import RoleMismatched
+                self._dispatch(RoleMismatched(id=request.id,
+                                              replica=handle.name,
+                                              prefix=len(emitted)))
+                continue
             except _DEAD as death:
                 self._fail(handle, f'died at restore ({death})')
                 continue
@@ -791,14 +1012,256 @@ class Router:
                         route=self._routes.get(request.id))
         return handle
 
+    # ----------------------------------------------------- crash recovery
+
+    def snapshot(self) -> dict:
+        """The router's authoritative state as a clock-portable dict —
+        what :class:`~tpusystem.serve.RouterJournal` packs every cadence
+        tick. Timestamps convert to waited-seconds at snapshot time
+        (monotonic clocks do not compare across processes) and parked
+        handoffs carry their digest-framed payload, so a relaunched
+        router can re-ship them without the prefill tier re-exporting."""
+        now = self._clock()
+        return {
+            'term': self.lease.term if self.lease is not None else 0,
+            'brownout': self.brownout,
+            'cooldown': self._cooldown,
+            'results': dict(self.results),
+            'routes': [(route.request, now - route.submitted, route.handle,
+                        route.attempt, route.hedged)
+                       for route in self._routes.values()],
+            'orphans': [(request, now - submitted_at, list(emitted))
+                        for request, submitted_at, emitted in self._orphans],
+            'undelivered': [(source_name, handoff.request, handoff.waited,
+                             list(handoff.prefix), pack_handoff(handoff))
+                            for source_name, handoff in self._undelivered],
+        }
+
+    def recover(self, clients: Any = ()) -> dict:
+        """Rebuild the fleet's authoritative state after a router crash
+        or standby takeover: read the router journal through the
+        ``clients`` preference chain (default: the journal's own client),
+        then health-sweep every replica. The completion-edge idempotency
+        table (``results``) restores FIRST, so nothing the old router
+        already settled can double-complete; journaled routes whose
+        replica still holds the row live (its request journal knows the
+        id) re-attach and **keep streaming**; routes on dead or unaware
+        replicas re-place (hot from the replica's own recovered journal
+        where possible, cold otherwise); parked ``kv:{request}``
+        handoffs re-queue for delivery from their journaled payload — a
+        corrupt payload re-prefills cold, never wrong. Narrated as one
+        ``RouterTakeover`` event; returns its counts as a dict."""
+        started = self._clock()
+        if self.lease is not None and self.journal is not None:
+            self.journal.term = self.lease.term
+        chain = tuple(clients)
+        if not chain and self.journal is not None:
+            chain = (self.journal.client,)
+        recovered = (recover_router_journal(self.journal.name, chain)
+                     if self.journal is not None else None)
+        reseated = replaced = settled = handoffs = 0
+        source = 'sweep'
+        requeued: set[str] = set()
+        if recovered is not None:
+            tick, state = recovered
+            self.journal.tick = tick     # pushes stay monotonic in the store
+            source = 'journal'
+            self.brownout = bool(state.get('brownout', False))
+            self._cooldown = int(state.get('cooldown', 0))
+            for request_id, completion in state.get('results', {}).items():
+                if request_id not in self.results:
+                    self.results[request_id] = completion
+                    settled += 1
+            # in-flight handoffs first, so the route loop below can tell
+            # "parked but re-shippable" from "strips lost with the router"
+            for source_name, request, waited, prefix, packed in \
+                    state.get('undelivered', ()):
+                if request.id in self.results:
+                    continue
+                try:
+                    handoff = unpack_handoff(packed)
+                except HandoffCorrupt:
+                    from tpusystem.observe.events import HandoffCorrupted
+                    self._dispatch(HandoffCorrupted(
+                        id=request.id, origin=source_name,
+                        target='(journal)'))
+                    src = self._by_name(source_name)
+                    if src is not None and src.healthy:
+                        try:
+                            src.shipped(request.id)
+                        except _DEAD as death:
+                            self._fail(src, f'died at takeover ({death})')
+                    if request.id not in self._routes:
+                        self._place(request, waited, list(prefix),
+                                    origin=source_name,
+                                    cause='handoff-corrupt', route=None)
+                        replaced += 1
+                    continue
+                self._undelivered.append((source_name, handoff))
+                requeued.add(request.id)
+                handoffs += 1
+            now = self._clock()
+            for request, waited, handle_name, attempt, hedged in \
+                    state.get('routes', ()):
+                request_id = request.id
+                if request_id in self.results or request_id in self._routes:
+                    continue
+                handle = self._by_name(handle_name)
+                if handle is not None and handle.healthy:
+                    try:
+                        handle._check()
+                        completion = handle.scheduler.results.get(request_id)
+                        journal = getattr(handle.scheduler, 'journal', None)
+                        row = (journal.rows.get(request_id)
+                               if journal is not None else None)
+                        shipping = request_id in getattr(
+                            handle.scheduler, '_shipping', ())
+                    except _DEAD as death:
+                        self._fail(handle,
+                                   f'died at takeover sweep ({death})')
+                    else:
+                        if completion is not None:
+                            # finished while the router was down: settle
+                            # at the completion edge, never re-place
+                            self.results[request_id] = completion
+                            settled += 1
+                            continue
+                        if row is not None and (not shipping
+                                                or request_id in requeued):
+                            # the seated row never stopped streaming (or
+                            # its handoff re-queued above): re-attach and
+                            # let it finish
+                            self._routes[request_id] = _Route(
+                                request, handle_name, now - waited, now,
+                                attempt=int(attempt),
+                                hedged=(hedged if hedged is not None
+                                        and self._is_healthy(hedged)
+                                        else None))
+                            reseated += 1
+                            continue
+                        if shipping:
+                            # the old router took the handoff but its
+                            # strips died with it: close the prefill
+                            # ledger and re-prefill on the decode tier
+                            try:
+                                handle.shipped(request_id)
+                            except _DEAD as death:
+                                self._fail(handle,
+                                           f'died at takeover ({death})')
+                        emitted = list(row.emitted) if row is not None else []
+                        if request_id not in self._routes:
+                            self._place(request, waited, emitted,
+                                        origin=handle_name,
+                                        cause='takeover', route=None)
+                            replaced += 1
+                        continue
+                # dead or missing replica: _fail above (or an earlier
+                # iteration) may already have re-homed it from the
+                # replica's own journal — only the remainder goes cold
+                if request_id in self.results:
+                    settled += 1
+                    continue
+                if request_id in self._routes:
+                    replaced += 1
+                    continue
+                self._place(request, waited, [], origin=handle_name,
+                            cause='takeover', route=None)
+                replaced += 1
+            for request, waited, emitted in state.get('orphans', ()):
+                if request.id in self.results or request.id in self._routes:
+                    continue
+                self._place(request, waited, list(emitted),
+                            origin='orphans', cause='takeover', route=None)
+                replaced += 1
+        swept_routes, swept_settled = self._sweep(requeued)
+        reseated += swept_routes
+        settled += swept_settled
+        seconds = self._clock() - started
+        term = self.lease.term if self.lease is not None else 0
+        logger.info(
+            'router takeover (%s, term %d): %d reseated, %d replaced, %d '
+            'settled, %d handoffs re-queued in %.3fs', source, term,
+            reseated, replaced, settled, handoffs, seconds)
+        from tpusystem.observe.events import RouterTakeover
+        report = dict(term=term, source=source, reseated=reseated,
+                      replaced=replaced, settled=settled, handoffs=handoffs,
+                      seconds=seconds)
+        self._dispatch(RouterTakeover(**report))
+        return report
+
+    def _sweep(self, requeued: set | None = None) -> tuple[int, int]:
+        """Health sweep: adopt whatever the replicas themselves still
+        know — their results dicts settle into the idempotency table,
+        their request journals' live rows become routes. This is the
+        whole cold rebuild when no router journal survives, and the
+        cadence-window backstop when one does. A live row stuck in a
+        replica's shipping ledger whose handoff did NOT survive
+        (``requeued``) re-prefills on the decode tier instead of
+        re-attaching — the strips died with the old router."""
+        requeued = requeued or set()
+        reseated = settled = 0
+        now = self._clock()
+        for handle in list(self.handles):
+            if not handle.healthy:
+                continue
+            try:
+                handle._check()
+                results = dict(handle.scheduler.results)
+                journal = getattr(handle.scheduler, 'journal', None)
+                rows = dict(journal.rows) if journal is not None else {}
+                shipping = set(getattr(handle.scheduler, '_shipping', ()))
+            except _DEAD as death:
+                self._fail(handle, f'died at takeover sweep ({death})')
+                continue
+            for request_id, completion in results.items():
+                if request_id in self.results:
+                    continue
+                self.results[request_id] = completion
+                self._routes.pop(request_id, None)
+                settled += 1
+            for request_id, row in rows.items():
+                if request_id in self.results or request_id in self._routes:
+                    continue
+                if request_id in shipping and request_id not in requeued:
+                    try:
+                        handle.shipped(request_id)
+                    except _DEAD as death:
+                        self._fail(handle,
+                                   f'died at takeover sweep ({death})')
+                        break
+                    self._place(row.request, now - row.submitted,
+                                list(row.emitted), origin=handle.name,
+                                cause='takeover', route=None)
+                    reseated += 1
+                    continue
+                self._routes[request_id] = _Route(row.request, handle.name,
+                                                  row.submitted, now)
+                reseated += 1
+        return reseated, settled
+
+    def _renew_lease(self) -> None:
+        try:
+            self.lease.renew()
+        except RouterFenced as fenced:
+            from tpusystem.observe.events import RouterDeposed
+            self._dispatch(RouterDeposed(term=fenced.term,
+                                         observed=fenced.observed))
+            raise
+
     # ------------------------------------------------------------ serving
 
     def step(self) -> FleetTick:
         """One fleet tick: step every healthy replica, settle
         completions (first wins under hedging), judge heartbeats, run
         the timeout/hedge ladder, shed past the fleet watermark, and
-        let the autoscaler breathe."""
+        let the autoscaler breathe. A held lease renews FIRST — a
+        deposed router must stop before placing anything this tick
+        (:exc:`RouterFenced` propagates; exit 47 under a supervisor) —
+        and the router journal replicates LAST, after every state change
+        the tick made."""
         self.ticks += 1
+        if self.lease is not None:
+            self._renew_lease()
         now = self._clock()
         completed: list = []
         emitted: dict = {}
@@ -836,11 +1299,16 @@ class Router:
         reroutes, self._reroutes_pending = self._reroutes_pending, []
         queued = sum(h.scheduler.queue_depth for h in self.healthy)
         active = sum(h.scheduler.active for h in self.healthy)
-        return FleetTick(replicas=len(self.healthy), queued=queued,
+        tick = FleetTick(replicas=len(self.healthy), queued=queued,
                          active=active, completed=completed,
                          rerouted=reroutes, shed=shed,
                          orphans=len(self._orphans), handoffs=handoffs,
                          emitted=emitted)
+        if self.journal is not None:
+            if self.lease is not None:
+                self.journal.term = self.lease.term
+            self.journal.observe_tick(self.snapshot)
+        return tick
 
     # ------------------------------------------------------------ handoff
 
@@ -924,6 +1392,10 @@ class Router:
                     'KV handoff for %r failed verification (%s); '
                     're-prefilling cold on the decode tier', request.id,
                     corrupt)
+                from tpusystem.observe.events import HandoffCorrupted
+                self._dispatch(HandoffCorrupted(id=request.id,
+                                                origin=source.name,
+                                                target=target.name))
                 source.shipped(request.id)
                 self._place(request, handoff.waited, list(handoff.prefix),
                             origin=source.name, cause='handoff-corrupt',

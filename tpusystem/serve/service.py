@@ -15,10 +15,19 @@ other subsystem in this framework follows.
 
 Hot-path rule: every event payload is an already-materialized host value
 (ints, floats, token lists) — consumers never see device arrays.
+
+:class:`FleetClient` is the fleet-level front door for callers that must
+survive the *router* dying (the PR-19 no-single-point-of-failure
+contract): it resolves "which router is serving right now" per call,
+redials the dead-router signatures with capped exponential backoff +
+jitter, and resubmits by request-id — idempotent, because
+:meth:`~tpusystem.serve.fleet.Router.submit` treats a known id as a
+no-op and the router journal carries settled results across a takeover.
 """
 
 from __future__ import annotations
 
+import random
 import time
 
 from tpusystem.observe.events import (Backpressure, LoadShed,
@@ -26,6 +35,7 @@ from tpusystem.observe.events import (Backpressure, LoadShed,
                                       RequestEvicted, RequestExpired,
                                       ServeStepped, TokenStreamed)
 from tpusystem.serve.engine import Engine
+from tpusystem.serve.fleet import RouterFenced
 from tpusystem.serve.scheduler import Request, Scheduler, serve_levers
 from tpusystem.services.prodcon import Producer
 from tpusystem.services.service import Service
@@ -194,3 +204,79 @@ class InferenceService:
     @property
     def results(self) -> dict:
         return self.scheduler.results
+
+
+class FleetClient:
+    """A fleet client that survives router death (warm-standby redial).
+
+    ``resolve() -> Router`` answers "who is serving right now" — after a
+    takeover that is a *different* router object (or process); while the
+    standby is still fencing it may raise the same dead signatures a
+    direct call would. Every operation resolves fresh, and any
+    dead-router signature (``ConnectionError`` / ``OSError`` — the
+    socket death of a killed router — or :exc:`~tpusystem.serve.fleet.
+    RouterFenced` from a not-yet-deposed zombie) retries with capped
+    exponential backoff + jitter (seeded, so drills replay identically
+    and a herd of clients decorrelates instead of redialing in phase).
+
+    Retrying is safe because submission is **request-id idempotent** at
+    the router: a resubmit of a settled request returns ``'settled'``
+    (read :meth:`result`), an in-flight one returns its current
+    placement, and the router journal carries both tables across the
+    takeover — a client can never double-run a request by redialing.
+
+    ``sleep`` is injectable (the tier-1 drills run zero real sleeps);
+    redials exhausted raises ``ConnectionError`` — the typed "no router
+    ever came back" verdict.
+    """
+
+    _DEAD = (ConnectionError, OSError, RouterFenced)
+
+    def __init__(self, resolve, *, max_redials: int = 8,
+                 backoff_base: float = 0.05, backoff_cap: float = 2.0,
+                 jitter: float = 0.25, seed: int = 0,
+                 sleep=time.sleep) -> None:
+        if max_redials < 0 or backoff_base <= 0 or backoff_cap < backoff_base:
+            raise ValueError('need max_redials >= 0 and 0 < backoff_base '
+                             '<= backoff_cap')
+        self._resolve = resolve
+        self.max_redials = max_redials
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.jitter = jitter
+        self._rng = random.Random(seed)
+        self._sleep = sleep
+        self.redials = 0             # takeover-visibility counter
+
+    def _backoff(self, attempt: int) -> float:
+        delay = min(self.backoff_cap, self.backoff_base * 2 ** attempt)
+        return delay * (1.0 + self.jitter * self._rng.random())
+
+    def _call(self, op):
+        last = None
+        for attempt in range(self.max_redials + 1):
+            if attempt:
+                self.redials += 1
+                self._sleep(self._backoff(attempt - 1))
+            try:
+                return op(self._resolve())
+            except self._DEAD as error:
+                last = error
+        raise ConnectionError(
+            f'router unreachable after {self.max_redials} redials — no '
+            f'standby took over') from last
+
+    def submit(self, request) -> str:
+        """Route the request on the current router; returns its
+        placement, or ``'settled'`` when a redial finds it already
+        completed (read :meth:`result`)."""
+        return self._call(lambda router: router.submit(request))
+
+    def cancel(self, request_id: str):
+        return self._call(lambda router: router.cancel(request_id))
+
+    def result(self, request_id: str):
+        """The request's Completion once settled, None while in flight
+        — served from the idempotency table the router journal carries
+        across takeovers."""
+        return self._call(lambda router: router.results.get(request_id))
