@@ -269,6 +269,17 @@ def router_failover_row() -> None:
     _overlap_probe_row('serve_failover.py', 'router_failover_seconds')
 
 
+def arbitration_row() -> None:
+    """The gang-orchestrator arbitration row: wall seconds from a
+    serving burst's ``request_capacity`` to the shrunk trainer stepping
+    again on its granted-down submesh — the two-phase journaled
+    decision plus the exit-46 hot reshard
+    (`benchmarks/arbitration.py headline`; the capacity arbitration of
+    `tpusystem/orchestrator/gang.py` — decision-only and release/ebb
+    arms ride alongside)."""
+    _overlap_probe_row('arbitration.py', 'arbitration_seconds')
+
+
 def serve_disagg_ttft_row() -> None:
     """The disaggregated-serving head-of-line row: p99 submit→first-token
     over the SHORT requests of a mixed long:short workload, prefill-role
@@ -668,6 +679,7 @@ if __name__ == '__main__':
     serve_recovery_row()
     fleet_recovery_row()
     router_failover_row()
+    arbitration_row()
     serve_disagg_ttft_row()
     embedding_row()
     serve_ttft_row()

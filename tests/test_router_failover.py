@@ -468,6 +468,38 @@ class TestFleetClient:
         with pytest.raises(ValueError):
             FleetClient(lambda: None, backoff_base=0.5, backoff_cap=0.1)
 
+    def test_backoff_schedule_is_deterministic_by_seed(self):
+        """The PR-19 capped-backoff path, pinned: the jitter schedule is
+        a pure function of the seed — two clients with the same seed
+        sleep the identical schedule, a different seed diverges, and a
+        red redial storm replays exactly from its seed (the Faults /
+        pick_chaos discipline applied to client backoff)."""
+        def schedule(seed, failures=6):
+            calls, sleeps = [0], []
+
+            class Standby:
+                @staticmethod
+                def submit(request):
+                    return 'rep0'
+
+            def resolve():
+                calls[0] += 1
+                if calls[0] <= failures:
+                    raise ConnectionError('router socket died')
+                return Standby()
+            client = FleetClient(resolve, sleep=sleeps.append, seed=seed)
+            client.submit(Request('a', [1], 4))
+            return sleeps
+
+        first, again = schedule(seed=11), schedule(seed=11)
+        assert first == again                 # same seed, same schedule
+        assert len(first) == 6
+        assert schedule(seed=12) != first     # the jitter is really there
+        # every slept value obeys the cap and the bounded-jitter window
+        for attempt, slept in enumerate(first):
+            base = min(2.0, 0.05 * 2 ** attempt)
+            assert base <= slept <= base * 1.25
+
     def test_end_to_end_resubmit_across_a_takeover(self):
         """The whole client contract in one move: submit, router dies,
         redial finds the standby, resubmit by id is idempotent, and
@@ -492,6 +524,75 @@ class TestFleetClient:
         healer = FleetClient(resolve, sleep=lambda s: heal())
         assert healer.submit(Request('a', [1], 3)) == 'settled'
         assert healer.result('a').tokens == expected_tokens('a', 3)
+
+
+# ---------------------------------------------------------------------------
+# the double-failure window: the standby dies before its takeover
+# completes, the fenced incumbent is already gone
+# ---------------------------------------------------------------------------
+
+
+class TestDoubleFailureWindow:
+
+    def test_standby_death_mid_takeover_leaves_journal_recoverable(self):
+        """Standby #1 fences the term and dies BEFORE ``recover()``
+        completes, with the fenced incumbent already gone — the worst
+        moment. The journal (pushed by the incumbent, untouched by the
+        half-takeover) must still recover a FRESH standby, which fences
+        a higher term and drains every in-flight row token-exact."""
+        clock = FakeClock()
+        router, _, plane = journaled_fleet(clock, n=2)
+        for request_id in ('a', 'b', 'c'):
+            router.submit(Request(request_id, [1], 6))
+        router.step()
+        router.step()
+        # the incumbent is gone (SIGKILL form: the object is abandoned,
+        # its last journal push outlives it on the plane)
+        half_lease = RouterLease(client=plane, clock=clock,
+                                 holder='standby-1')
+        half_lease.acquire()                 # the fence landed (term 2)...
+        # ...and standby-1 died right here: no recover(), no serving.
+        # A fresh standby must not be blocked by the orphaned fence:
+        fresh_lease = RouterLease(client=plane, clock=clock,
+                                  holder='standby-2')
+        standby = Router(router.handles, clock=clock,
+                         journal=RouterJournal(client=plane),
+                         lease=fresh_lease)
+        fresh_lease.acquire()
+        assert fresh_lease.term > half_lease.term   # a THIRD term
+        report = standby.recover((plane,))
+        assert report['reseated'] + report['replaced'] >= 1
+        drain(standby)
+        for request_id in ('a', 'b', 'c'):
+            assert (standby.results[request_id].tokens
+                    == expected_tokens(request_id, 6))
+        # and the orphan's late renewal is fenced out like any zombie's
+        clock.advance(1.5)
+        with pytest.raises(RouterFenced):
+            half_lease.renew()
+
+    def test_supervisor_narrates_the_standby_death_and_relaunches(self):
+        """The supervised form of the same window: the standby process
+        is killed mid-takeover (signal death — restartable by the exit
+        table), the supervisor narrates the exit and relaunches, and
+        the relaunched standby is exactly the 'fresh standby' of the
+        drill above."""
+        from tpusystem.observe.events import WorkerExited, WorkerRelaunched
+        from tpusystem.services.prodcon import Consumer
+        clock = SupervisorClock()
+        popen = scripted(FakeWorker(-9), FakeWorker(0))
+        supervisor = policy_supervisor(popen, clock)
+        producer, seen = Producer(), []
+        consumer = Consumer()
+        consumer.register(WorkerExited, seen.append)
+        consumer.register(WorkerRelaunched, seen.append)
+        producer.register(consumer)
+        supervisor.producer = producer
+        assert supervisor.run() == 0
+        assert len(popen.launched) == 2      # killed once, relaunched once
+        actions = [event.action for event in seen
+                   if isinstance(event, WorkerExited)]
+        assert actions == ['relaunch', 'done']
 
 
 # ---------------------------------------------------------------------------

@@ -12,11 +12,13 @@ has exactly one device→host sync per phase (``metrics.compute()``).
 """
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
-                                      Iterated, RecoveryTimeline,
-                                      ReplicaDiverged, RequestAdmitted,
-                                      RequestCompleted, RequestEvicted,
-                                      RolledBack, ServeStepped, StepTimed,
-                                      Trained, Validated, WorkerExited,
+                                      CapacityArbitrated, Iterated,
+                                      JobAdmitted, JobHalted, JobPreempted,
+                                      RecoveryTimeline, ReplicaDiverged,
+                                      RequestAdmitted, RequestCompleted,
+                                      RequestEvicted, RolledBack,
+                                      ServeStepped, StepTimed, Trained,
+                                      Validated, WorkerExited,
                                       WorkerRelaunched)
 from tpusystem.observe.flight import FlightRecorder
 from tpusystem.observe.ledger import EventLedger, LedgerDivergence
@@ -45,6 +47,7 @@ __all__ = [
     'AnomalyDetected', 'BackoffApplied', 'RolledBack', 'ReplicaDiverged',
     'WorkerExited', 'WorkerRelaunched', 'RecoveryTimeline',
     'RequestAdmitted', 'RequestEvicted', 'RequestCompleted', 'ServeStepped',
+    'JobAdmitted', 'JobPreempted', 'JobHalted', 'CapacityArbitrated',
     'logging_consumer', 'SummaryWriter', 'tensorboard_consumer',
     'tracking_consumer', 'checkpoint_consumer', 'experiment',
     'metrics_store', 'models_store',

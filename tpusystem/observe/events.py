@@ -444,3 +444,59 @@ class ElasticTimeline:
     source: str | None
     seconds: float
     stages: dict
+
+
+# --------------------------------------------------------------------------
+# orchestrator events — the multi-tenant gang narrative
+# (tpusystem.orchestrator): admissions, halts, and capacity arbitration
+# between tenants sharing one physical mesh. Orchestrator dispatches ride
+# the SHARED producer deliberately — they are fleet-of-jobs facts, not
+# one tenant's business — while each event's ``job`` field names the
+# tenant it concerns (and a tenant's own bus stamps `.tenant` on events
+# it emits; tpusystem.orchestrator.namespace has the scoping rules).
+
+
+@event
+class JobAdmitted:
+    """The orchestrator seated a job on its submesh: ``chips`` devices
+    carved from the pool, under ``priority`` (larger wins capacity)."""
+    job: str
+    kind: str
+    priority: int
+    chips: int
+
+
+@event
+class JobPreempted:
+    """Capacity arbitration shrank ``job`` by ``chips`` devices in
+    favor of higher-priority tenant ``to`` — the
+    ``Supervisor.resize()`` / exit-46 path, so the shrunk job resumes
+    token-exact on its smaller submesh and the move is a recorded debt
+    the ebb pays back."""
+    job: str
+    chips: int
+    to: str
+
+
+@event
+class JobHalted:
+    """A tenant exited outside ``RESTART_EXITS`` and was halted —
+    devices freed, nothing else touched (the blast-radius contract).
+    ``reason`` is the typed verdict for ``code``
+    (docs/multihost.md#restart-exit-code-table)."""
+    job: str
+    code: int
+    reason: str
+
+
+@event
+class CapacityArbitrated:
+    """One completed (two-phase-journaled) arbitration: a ``'grant'``
+    moved ``chips`` devices toward ``requester`` (from the free pool
+    and/or ``donor``), a ``'release'`` paid them back on ebb.
+    ``seconds`` is decide → both sides re-ganged."""
+    kind: str
+    requester: str
+    donor: str | None
+    chips: int
+    seconds: float

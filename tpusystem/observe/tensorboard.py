@@ -27,16 +27,18 @@ import struct
 import time
 
 from tpusystem.observe.events import (AnomalyDetected, BackoffApplied,
-                                      Backpressure, ElasticTimeline,
-                                      EngineRestarted, FleetResized,
-                                      HandoffCorrupted, LoadShed,
-                                      PrefillHandoff, RecoveryTimeline,
-                                      RecsysEvaluated, ReplicaDiverged,
-                                      ReplicaUnhealthy, RequestAdmitted,
-                                      RequestExpired, RequestRerouted,
-                                      RoleMismatched, RolledBack,
-                                      RouterTakeover, ServeStepped, Trained,
-                                      Validated, WorkerExited, WorldResized)
+                                      Backpressure, CapacityArbitrated,
+                                      ElasticTimeline, EngineRestarted,
+                                      FleetResized, HandoffCorrupted,
+                                      JobAdmitted, JobHalted, JobPreempted,
+                                      LoadShed, PrefillHandoff,
+                                      RecoveryTimeline, RecsysEvaluated,
+                                      ReplicaDiverged, ReplicaUnhealthy,
+                                      RequestAdmitted, RequestExpired,
+                                      RequestRerouted, RoleMismatched,
+                                      RolledBack, RouterTakeover,
+                                      ServeStepped, Trained, Validated,
+                                      WorkerExited, WorldResized)
 from tpusystem.services.prodcon import Consumer, Depends
 
 # ---------------------------------------------------------------- crc32c ---
@@ -424,5 +426,54 @@ def tensorboard_consumer() -> Consumer:
             board.add_scalar('elastic/reshard_hot',
                              1.0 if event.source == 'hot-reshard' else 0.0,
                              event.epoch)
+
+    # orchestrator/* dashboard — the multi-tenant gang narrative; the
+    # events are step-less (admissions and arbitrations are sparse), so
+    # they chart against closure counters like the fleet/* rows. Wire
+    # one tensorboard_consumer per tenant through a NamespacedWriter
+    # override ({tenant}/serve/..., {tenant}/train/...) for per-tenant
+    # charts; the orchestrator/* rows below are fleet-of-jobs facts and
+    # belong on the shared (un-prefixed) board.
+    admit_counts = [0]
+    halt_counts = [0]
+    preempt_counts = [0]
+    arbitrate_counts = [0]
+
+    @consumer.handler
+    def on_job_admitted(event: JobAdmitted,
+                        board: SummaryWriter = Depends(writer)) -> None:
+        admit_counts[0] += 1
+        board.add_scalar('orchestrator/jobs_admitted',
+                         float(admit_counts[0]), admit_counts[0])
+        board.add_scalar('orchestrator/admitted_chips', float(event.chips),
+                         admit_counts[0])
+
+    @consumer.handler
+    def on_job_halted(event: JobHalted,
+                      board: SummaryWriter = Depends(writer)) -> None:
+        halt_counts[0] += 1
+        board.add_scalar('orchestrator/jobs_halted', float(halt_counts[0]),
+                         halt_counts[0])
+        board.add_scalar('orchestrator/halt_code', float(event.code),
+                         halt_counts[0])
+
+    @consumer.handler
+    def on_job_preempted(event: JobPreempted,
+                         board: SummaryWriter = Depends(writer)) -> None:
+        preempt_counts[0] += 1
+        board.add_scalar('orchestrator/preemptions',
+                         float(preempt_counts[0]), preempt_counts[0])
+        board.add_scalar('orchestrator/preempted_chips', float(event.chips),
+                         preempt_counts[0])
+
+    @consumer.handler
+    def on_capacity_arbitrated(event: CapacityArbitrated,
+                               board: SummaryWriter = Depends(writer)
+                               ) -> None:
+        arbitrate_counts[0] += 1
+        board.add_scalar('orchestrator/arbitrations',
+                         float(arbitrate_counts[0]), arbitrate_counts[0])
+        board.add_scalar('orchestrator/arbitration_seconds', event.seconds,
+                         arbitrate_counts[0])
 
     return consumer

@@ -1,0 +1,43 @@
+"""Multi-tenant gang orchestration on one physical mesh.
+
+One device pool, N jobs (train, serve fleet, recsys, periodic eval) as
+isolated tenants — each under its own supervisor tree and control-plane
+namespace, with journaled preemptive capacity arbitration between them
+and a seeded cross-tenant chaos certifier over the lot. See
+ROADMAP item 4 and docs/orchestrator coverage in docs/api/.
+
+Layout mirrors the serve package's split:
+
+* :mod:`~tpusystem.orchestrator.namespace` — blast-radius isolation:
+  scoped consumers, tenant buses, leak audits, namespaced TB writers.
+* :mod:`~tpusystem.orchestrator.gang` — specs, the carve planner, the
+  :class:`Orchestrator` with two-phase journaled arbitration and
+  SIGKILL recovery, the :class:`SupervisedRunner` adapter.
+* :mod:`~tpusystem.orchestrator.journal` — the RouterJournal discipline
+  under the ``orch:{name}`` identity namespace.
+* :mod:`~tpusystem.orchestrator.certify` — the fleet-of-jobs chaos
+  drill (seeded tenant × component × kill-tick).
+"""
+
+from tpusystem.orchestrator.certify import (TenantCertifyReport,
+                                            TenantHarness, certify_tenants)
+from tpusystem.orchestrator.gang import (CapacityError, JobSpec,
+                                         Orchestrator, Submesh,
+                                         SupervisedRunner, Tenant, carve,
+                                         halt_reason)
+from tpusystem.orchestrator.journal import (OrchestratorJournal,
+                                            orchestrator_identity,
+                                            recover_orchestrator_journal)
+from tpusystem.orchestrator.namespace import (LeakAudit, NamespacedWriter,
+                                              ScopedConsumer, TenantBus,
+                                              scoped, subject_of)
+
+__all__ = [
+    'CapacityError', 'JobSpec', 'Submesh', 'carve', 'Tenant',
+    'Orchestrator', 'SupervisedRunner', 'halt_reason',
+    'OrchestratorJournal', 'orchestrator_identity',
+    'recover_orchestrator_journal',
+    'ScopedConsumer', 'scoped', 'subject_of', 'TenantBus', 'LeakAudit',
+    'NamespacedWriter',
+    'TenantHarness', 'TenantCertifyReport', 'certify_tenants',
+]

@@ -49,7 +49,8 @@ from tpusystem.parallel.multihost import Hub, TcpTransport
 
 __all__ = ['Faults', 'ChaosTransport', 'ChaosHub', 'DieAtStep', 'WorkerKilled',
            'PreemptionWave', 'StalledStep', 'CorruptGrads', 'CorruptBatch',
-           'FlipParamBit', 'ChaosPick', 'pick_chaos']
+           'FlipParamBit', 'ChaosPick', 'pick_chaos', 'TenantChaosPick',
+           'pick_tenant_chaos']
 
 
 @dataclass
@@ -241,6 +242,39 @@ def pick_chaos(seed: int, components: tuple[str, ...] | list[str], *,
     rng = random.Random(seed)
     component = components[rng.randrange(len(components))]
     return ChaosPick(component=component, step=rng.randint(lo, hi))
+
+
+@dataclass(frozen=True)
+class TenantChaosPick:
+    """One drawn multi-tenant chaos scenario: inside ``tenant``, kill
+    ``component`` after orchestrator tick ``step`` (see
+    :func:`pick_tenant_chaos`)."""
+
+    tenant: str
+    component: str
+    step: int
+
+
+def pick_tenant_chaos(seed: int, tenants: tuple[str, ...] | list[str],
+                      components: tuple[str, ...] | list[str], *,
+                      lo: int = 1, hi: int = 8) -> TenantChaosPick:
+    """Draw the victim for one multi-tenant chaos-certification run —
+    :func:`pick_chaos` lifted one level: a uniformly-chosen tenant, then
+    a uniformly-chosen component inside it, then a uniformly-chosen
+    kill tick in ``[lo, hi]``. All three draws come from one
+    ``random.Random(seed)`` in that fixed order, so a seed IS the
+    scenario and a red cross-tenant drill replays exactly from it."""
+    if not tenants:
+        raise ValueError('need at least one tenant to pick from')
+    if not components:
+        raise ValueError('need at least one component to pick from')
+    if lo < 0 or hi < lo:
+        raise ValueError(f'need 0 <= lo <= hi, got [{lo}, {hi}]')
+    rng = random.Random(seed)
+    tenant = tenants[rng.randrange(len(tenants))]
+    component = components[rng.randrange(len(components))]
+    return TenantChaosPick(tenant=tenant, component=component,
+                           step=rng.randint(lo, hi))
 
 
 class WorkerKilled(RuntimeError):
